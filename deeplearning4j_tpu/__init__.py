@@ -1,0 +1,16 @@
+"""deeplearning4j_tpu: a TPU-native deep-learning framework with the
+capabilities of Deeplearning4j (reference: corasaniti/deeplearning4j @
+0.7.3-SNAPSHOT), built on JAX/XLA/pjit.
+
+Public API mirrors the reference's shape — builder configs,
+MultiLayerNetwork/ComputationGraph, listeners, evaluation, serialization,
+ParallelWrapper — while the compute path is idiomatic JAX: pure functions,
+pytrees, one jitted XLA program per train step, SPMD over a device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .nn.conf.neural_net_configuration import (  # noqa: F401
+    NeuralNetConfiguration, MultiLayerConfiguration)
+from .nn.multilayer import MultiLayerNetwork  # noqa: F401
+from .datasets.dataset import DataSet, MultiDataSet  # noqa: F401

@@ -1,0 +1,69 @@
+"""DataSet / MultiDataSet batch containers.
+
+TPU-native equivalent of ND4J's ``DataSet`` / ``MultiDataSet`` (consumed
+throughout the reference — SURVEY.md §2.10).  A batch is a pytree of device
+arrays (features, labels, optional masks), so it can be donated into the
+jitted train step and sharded with ``jax.sharding`` without conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class DataSet:
+    """One minibatch: features (batch, ...), one-hot/regression labels
+    (batch, ...), optional per-timestep masks (batch, time)."""
+
+    features: np.ndarray | Array
+    labels: np.ndarray | Array
+    features_mask: Optional[np.ndarray | Array] = None
+    labels_mask: Optional[np.ndarray | Array] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def as_tuple(self):
+        return (self.features, self.labels, self.features_mask,
+                self.labels_mask)
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        def _slice(a, sl):
+            return None if a is None else a[sl]
+        tr = DataSet(*[_slice(a, slice(0, n_train)) for a in self.as_tuple()])
+        te = DataSet(*[_slice(a, slice(n_train, None)) for a in self.as_tuple()])
+        return tr, te
+
+    def shuffle(self, seed: int = 0) -> "DataSet":
+        perm = np.random.RandomState(seed).permutation(self.num_examples())
+        def _take(a):
+            return None if a is None else np.asarray(a)[perm]
+        return DataSet(*[_take(a) for a in self.as_tuple()])
+
+    def batch_by(self, batch_size: int):
+        n = self.num_examples()
+        for start in range(0, n, batch_size):
+            sl = slice(start, min(start + batch_size, n))
+            yield DataSet(*[None if a is None else a[sl]
+                            for a in self.as_tuple()])
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output batch (reference ``MultiDataSet`` consumed by
+    ``ComputationGraph.fit`` — SURVEY.md §3.2)."""
+
+    features: Sequence[np.ndarray | Array]
+    labels: Sequence[np.ndarray | Array]
+    features_masks: Optional[Sequence[Optional[np.ndarray | Array]]] = None
+    labels_masks: Optional[Sequence[Optional[np.ndarray | Array]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,125 @@
+"""Classification evaluation: accuracy/precision/recall/F1/confusion matrix.
+
+TPU-native equivalent of the reference's ``eval/Evaluation.java`` (1070 LoC;
+``eval(realOutcomes, guesses):191``, ``stats():352``) and
+``eval/ConfusionMatrix.java``.  Batches accumulate into a numpy confusion
+matrix; the heavy part (network forward) stays on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Counts actual x predicted (reference ``eval/ConfusionMatrix.java``)."""
+
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def actual_total(self, actual: int) -> int:
+        return int(self.matrix[actual].sum())
+
+    def predicted_total(self, predicted: int) -> int:
+        return int(self.matrix[:, predicted].sum())
+
+
+class Evaluation:
+    """Accumulating classification metrics (reference ``eval/Evaluation.java``).
+
+    ``eval(labels, predictions)`` takes one-hot (or probability) labels and
+    network output probabilities of shape (batch, n_classes) — or
+    (batch, n_classes, time)-free RNN shapes flattened by the caller.
+    """
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 label_names: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = label_names
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int) -> None:
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            # RNN (batch, time, classes) -> flatten time-major
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        self._ensure(labels.shape[-1])
+        actual = labels.argmax(-1)
+        guess = predictions.argmax(-1)
+        np.add.at(self.confusion.matrix, (actual, guess), 1)
+
+    def eval_time_series(self, labels, predictions, mask=None) -> None:
+        self.eval(labels, predictions, mask)
+
+    # ---- metrics (reference accuracy()/precision()/recall()/f1()) --------
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp = self.confusion.get_count(cls, cls)
+            denom = self.confusion.predicted_total(cls)
+            return tp / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)
+                if self.confusion.actual_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp = self.confusion.get_count(cls, cls)
+            denom = self.confusion.actual_total(cls)
+            return tp / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)
+                if self.confusion.actual_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        fp = self.confusion.predicted_total(cls) - self.confusion.get_count(
+            cls, cls)
+        negatives = self.confusion.matrix.sum() - self.confusion.actual_total(
+            cls)
+        return fp / negatives if negatives else 0.0
+
+    def stats(self) -> str:
+        """Pretty-printed summary (reference ``stats():352``)."""
+        lines = ["", "========================Evaluation Metrics========================",
+                 f" # of classes:  {self.num_classes}",
+                 f" Accuracy:      {self.accuracy():.4f}",
+                 f" Precision:     {self.precision():.4f}",
+                 f" Recall:        {self.recall():.4f}",
+                 f" F1 Score:      {self.f1():.4f}",
+                 "", "=========================Confusion Matrix========================="]
+        m = self.confusion.matrix
+        header = "     " + " ".join(f"{j:5d}" for j in range(self.num_classes))
+        lines.append(header)
+        for i in range(self.num_classes):
+            name = (self.label_names[i] if self.label_names
+                    else str(i))
+            lines.append(f"{name:>4} " + " ".join(
+                f"{m[i, j]:5d}" for j in range(self.num_classes)))
+        lines.append("==================================================================")
+        return "\n".join(lines)

@@ -1,0 +1,97 @@
+"""Numerical-vs-analytic gradient checking.
+
+TPU-native equivalent of the reference's
+``gradientcheck/GradientCheckUtil.java`` (``checkGradients(MLN):76``,
+``checkGradients(ComputationGraph):222``) — the backbone of the reference
+test suite (SURVEY.md §4).  The analytic gradient comes from ``jax.grad`` of
+the network loss; the numerical gradient is a central difference on the flat
+parameter vector in float64 (tests enable ``jax_enable_x64``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_EPS = 1e-6
+DEFAULT_MAX_REL_ERROR = 1e-3
+DEFAULT_MIN_ABS_ERROR = 1e-8
+
+
+def check_gradients(net, dataset, eps: float = DEFAULT_EPS,
+                    max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                    min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+                    print_results: bool = False,
+                    subset: Optional[int] = None,
+                    seed: int = 0) -> bool:
+    """Compare analytic vs numerical gradients of the total score.
+
+    Mirrors ``GradientCheckUtil.checkGradients``: perturb each flat param
+    +/-eps, compare (f(p+) - f(p-)) / 2eps against the analytic gradient with
+    a relative-error threshold; ``min_abs_error`` forgives tiny absolute
+    differences (reference semantics).  ``subset`` randomly samples that many
+    params for large nets.
+    """
+    net.init()
+    features = jnp.asarray(dataset.features)
+    labels = jnp.asarray(dataset.labels)
+    lmask = (None if dataset.labels_mask is None
+             else jnp.asarray(dataset.labels_mask))
+
+    def total_loss(params):
+        data_loss, _ = net._loss_fn(params, net.net_state, features, labels,
+                                    lmask, None, False)
+        return data_loss + net._reg_score(params)
+
+    analytic_tree = jax.grad(total_loss)(net.params)
+
+    # Flatten analytic grads in the same deterministic order as flat params.
+    analytic = []
+    for i, layer in enumerate(net.layers):
+        for name in layer.param_order():
+            analytic.append(np.asarray(analytic_tree[i][name]).ravel())
+    analytic = (np.concatenate(analytic) if analytic
+                else np.zeros((0,), np.float64))
+
+    flat0 = net.get_flat_params().astype(np.float64)
+    n = flat0.size
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.random.RandomState(seed).choice(n, subset, replace=False)
+
+    def loss_at(flat) -> float:
+        net.set_flat_params(flat)
+        return float(total_loss(net.params))
+
+    n_pass = n_fail = 0
+    max_err = 0.0
+    try:
+        for j in idxs:
+            orig = flat0[j]
+            flat0[j] = orig + eps
+            f_plus = loss_at(flat0)
+            flat0[j] = orig - eps
+            f_minus = loss_at(flat0)
+            flat0[j] = orig
+            numeric = (f_plus - f_minus) / (2.0 * eps)
+            a = float(analytic[j])
+            denom = abs(a) + abs(numeric)
+            rel = 0.0 if denom == 0 else abs(a - numeric) / denom
+            if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+                n_fail += 1
+                if print_results:
+                    print(f"param {j}: analytic={a:.8g} numeric={numeric:.8g} "
+                          f"rel={rel:.4g} FAIL")
+            else:
+                n_pass += 1
+            max_err = max(max_err, rel)
+    finally:
+        net.set_flat_params(flat0)
+
+    if print_results:
+        print(f"GradientCheck: {n_pass} passed, {n_fail} failed "
+              f"(maxRelError={max_err:.4g})")
+    return n_fail == 0

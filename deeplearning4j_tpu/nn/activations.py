@@ -1,0 +1,136 @@
+"""Activation functions.
+
+TPU-native equivalent of ND4J's ``IActivation`` SPI (consumed by DL4J at
+``nn/conf/NeuralNetConfiguration`` via the ``Activation`` enum — see reference
+``deeplearning4j-nn`` imports surveyed in SURVEY.md §2.10).  In the reference,
+each activation carries value + gradient; here every activation is a pure
+``jnp`` function and the gradient comes for free from ``jax.grad``, so the
+whole set stays fusable into a single XLA program.
+
+Activations are referenced by lowercase string name in layer configs (the JSON
+round-trip representation, mirroring DL4J's enum serialization).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def identity(x: Array) -> Array:
+    return x
+
+
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+def leakyrelu(x: Array, alpha: float = 0.01) -> Array:
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def softmax(x: Array) -> Array:
+    """Row-wise softmax over the last (feature) axis."""
+    return jax.nn.softmax(x, axis=-1)
+
+
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+def elu(x: Array, alpha: float = 1.0) -> Array:
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def rationaltanh(x: Array) -> Array:
+    """Rational approximation of tanh (ND4J ``ActivationRationalTanh``).
+
+    tanh(y) ~ sgn(y) * (1 - 1/(1 + |y| + y^2 + 1.41645 * y^4)) with y = 0.66667*x.
+    """
+    y = 0.66667 * x
+    ay = jnp.abs(y)
+    approx = 1.0 - 1.0 / (1.0 + ay + y * y + 1.41645 * (y ** 4))
+    return 1.7159 * jnp.sign(y) * approx
+
+
+def rectifiedtanh(x: Array) -> Array:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def hardsigmoid(x: Array) -> Array:
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def selu(x: Array) -> Array:
+    return jax.nn.selu(x)
+
+
+def gelu(x: Array) -> Array:
+    return jax.nn.gelu(x)
+
+
+def swish(x: Array) -> Array:
+    return jax.nn.silu(x)
+
+
+_ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "identity": identity,
+    "linear": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "leakyrelu": leakyrelu,
+    "softmax": softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "elu": elu,
+    "cube": cube,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "hardtanh": hardtanh,
+    "hardsigmoid": hardsigmoid,
+    "selu": selu,
+    "gelu": gelu,
+    "swish": swish,
+}
+
+
+def get(name: str) -> Callable[[Array], Array]:
+    """Resolve an activation by (case-insensitive) name.
+
+    Mirrors DL4J's ``Activation.fromString`` lookup.
+    """
+    key = name.lower()
+    if key not in _ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(_ACTIVATIONS)}"
+        )
+    return _ACTIVATIONS[key]
+
+
+def available() -> list[str]:
+    return sorted(_ACTIVATIONS)

@@ -1,0 +1,102 @@
+"""Input-type system for shape inference.
+
+TPU-native equivalent of the reference's ``nn/conf/inputs/InputType.java:52-84``
+(``feedForward`` / ``recurrent`` / ``convolutional`` / ``convolutionalFlat``
+factories).  Used by the list/graph builders to infer each layer's ``n_in``
+from the declared network input and to auto-insert preprocessors between layer
+families (``ListBuilder.setInputType`` — reference
+``NeuralNetConfiguration.java:255``).
+
+Layout note (TPU-first): convolutional activations are NHWC (XLA:TPU's
+preferred layout) and recurrent activations are (batch, time, features) —
+unlike the reference's NCHW / (batch, features, time).  Converters at the
+serialization/import boundary handle the mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from . import serde
+
+
+@serde.register("input_ff")
+@dataclasses.dataclass
+class InputTypeFeedForward:
+    size: int = 0
+
+    @property
+    def kind(self) -> str:
+        return "ff"
+
+    def flat_size(self) -> int:
+        return self.size
+
+
+@serde.register("input_recurrent")
+@dataclasses.dataclass
+class InputTypeRecurrent:
+    size: int = 0
+    timesteps: int = -1  # -1 = variable (padded/bucketed at batch time)
+
+    @property
+    def kind(self) -> str:
+        return "recurrent"
+
+    def flat_size(self) -> int:
+        return self.size
+
+
+@serde.register("input_cnn")
+@dataclasses.dataclass
+class InputTypeConvolutional:
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    @property
+    def kind(self) -> str:
+        return "cnn"
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+
+@serde.register("input_cnn_flat")
+@dataclasses.dataclass
+class InputTypeConvolutionalFlat:
+    """Flattened image input (e.g. MNIST rows of 784) that should be reshaped
+    to NHWC before the first conv layer (reference ``convolutionalFlat``)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    @property
+    def kind(self) -> str:
+        return "cnn_flat"
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+
+InputType = (InputTypeFeedForward | InputTypeRecurrent |
+             InputTypeConvolutional | InputTypeConvolutionalFlat)
+
+
+def feed_forward(size: int) -> InputTypeFeedForward:
+    return InputTypeFeedForward(size)
+
+
+def recurrent(size: int, timesteps: int = -1) -> InputTypeRecurrent:
+    return InputTypeRecurrent(size, timesteps)
+
+
+def convolutional(height: int, width: int, channels: int) -> InputTypeConvolutional:
+    return InputTypeConvolutional(height, width, channels)
+
+
+def convolutional_flat(height: int, width: int,
+                       channels: int = 1) -> InputTypeConvolutionalFlat:
+    return InputTypeConvolutionalFlat(height, width, channels)

@@ -1,0 +1,392 @@
+"""Network configuration: global hyperparameters, fluent builder, and the
+multi-layer configuration with JSON round-trip.
+
+TPU-native equivalent of the reference's ``nn/conf/NeuralNetConfiguration.java``
+(builder methods at 521-900), ``nn/conf/MultiLayerConfiguration.java``
+(``toJson``/``fromJson`` at 79-124), ``BackpropType``, and the
+``ListBuilder.setInputType`` shape-inference pass
+(``NeuralNetConfiguration.java:255``) that infers each layer's ``n_in`` and
+auto-inserts preprocessors between layer families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from ..updaters import UpdaterConfig
+from ..weights import Distribution
+from . import inputs as _inputs
+from . import preprocessors as _pp
+from . import serde
+from ..layers.base import BaseLayerConfig
+
+InputType = _inputs.InputType
+
+
+@serde.register("global_conf")
+@dataclasses.dataclass
+class GlobalConfig:
+    """Network-level defaults cloned into layers unless overridden
+    (reference ``NeuralNetConfiguration`` fields)."""
+
+    seed: int = 12345
+    num_iterations: int = 1
+    optimization_algo: str = "stochastic_gradient_descent"
+    mini_batch: bool = True          # average score/grads over batch
+    minimize: bool = True
+    dtype: str = "float32"           # param dtype; bfloat16 compute opt-in
+    compute_dtype: Optional[str] = None  # e.g. "bfloat16" for MXU-friendly matmuls
+    updater: UpdaterConfig = dataclasses.field(default_factory=UpdaterConfig)
+    activation: str = "sigmoid"
+    weight_init: str = "xavier"
+    dist: Optional[Distribution] = None
+    bias_init: float = 0.0
+    dropout: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    gradient_normalization: str = "none"
+    gradient_normalization_threshold: float = 1.0
+
+    def layer_defaults(self) -> Dict[str, object]:
+        return {
+            "activation": self.activation,
+            "weight_init": self.weight_init,
+            "dist": self.dist,
+            "bias_init": self.bias_init,
+            "dropout": self.dropout,
+            "l1": self.l1,
+            "l2": self.l2,
+            "l1_bias": self.l1_bias,
+            "l2_bias": self.l2_bias,
+            "updater": self.updater,
+            "gradient_normalization": (
+                None if self.gradient_normalization in ("none", None)
+                else self.gradient_normalization),
+        }
+
+
+@serde.register("multi_layer_conf")
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Reference ``MultiLayerConfiguration``: ordered layer configs +
+    per-boundary input preprocessors + backprop settings."""
+
+    conf: GlobalConfig = dataclasses.field(default_factory=GlobalConfig)
+    layers: List[BaseLayerConfig] = dataclasses.field(default_factory=list)
+    input_preprocessors: Dict[int, object] = dataclasses.field(
+        default_factory=dict)
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "standard"      # standard | tbptt
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    input_type: Optional[object] = None
+
+    # ---- JSON round-trip (reference MultiLayerConfiguration.java:79-124) --
+    def to_dict(self) -> dict:
+        return serde.to_dict(self)
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        out = serde.from_dict(d)
+        out.input_preprocessors = {
+            int(k): v for k, v in out.input_preprocessors.items()}
+        return out
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+class NeuralNetConfiguration:
+    """Namespace mirroring the reference entry point:
+    ``NeuralNetConfiguration.Builder()`` starts a fluent config chain."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+    Builder = None  # assigned below
+
+
+class Builder:
+    """Fluent global-hyperparameter builder (reference
+    ``NeuralNetConfiguration.Builder``, methods at 521-900)."""
+
+    def __init__(self):
+        self._g = GlobalConfig()
+
+    # -- core ---------------------------------------------------------------
+    def seed(self, seed: int) -> "Builder":
+        self._g.seed = int(seed)
+        return self
+
+    def iterations(self, n: int) -> "Builder":
+        self._g.num_iterations = int(n)
+        return self
+
+    def optimization_algo(self, algo: str) -> "Builder":
+        self._g.optimization_algo = algo.lower()
+        return self
+
+    def mini_batch(self, flag: bool) -> "Builder":
+        self._g.mini_batch = flag
+        return self
+
+    def minimize(self, flag: bool = True) -> "Builder":
+        self._g.minimize = flag
+        return self
+
+    def dtype(self, dtype: str) -> "Builder":
+        self._g.dtype = dtype
+        return self
+
+    def compute_dtype(self, dtype: str) -> "Builder":
+        """bfloat16 compute for MXU-friendly matmuls (TPU-first extension)."""
+        self._g.compute_dtype = dtype
+        return self
+
+    # -- updater / lr -------------------------------------------------------
+    def updater(self, updater: str | UpdaterConfig) -> "Builder":
+        if isinstance(updater, UpdaterConfig):
+            self._g.updater = updater
+        else:
+            self._g.updater.updater = updater.lower()
+        return self
+
+    def learning_rate(self, lr: float) -> "Builder":
+        self._g.updater.learning_rate = float(lr)
+        return self
+
+    def learning_rate_decay_policy(self, policy: str) -> "Builder":
+        self._g.updater.lr_policy = policy.lower()
+        return self
+
+    def lr_policy_decay_rate(self, rate: float) -> "Builder":
+        self._g.updater.lr_policy_decay_rate = float(rate)
+        return self
+
+    def lr_policy_power(self, power: float) -> "Builder":
+        self._g.updater.lr_policy_power = float(power)
+        return self
+
+    def lr_policy_steps(self, steps: float) -> "Builder":
+        self._g.updater.lr_policy_steps = float(steps)
+        return self
+
+    def learning_rate_schedule(self, schedule: Dict[int, float]) -> "Builder":
+        self._g.updater.lr_schedule = dict(schedule)
+        self._g.updater.lr_policy = "schedule"
+        return self
+
+    def momentum(self, momentum: float) -> "Builder":
+        self._g.updater.momentum = float(momentum)
+        return self
+
+    def momentum_after(self, schedule: Dict[int, float]) -> "Builder":
+        self._g.updater.momentum_schedule = dict(schedule)
+        return self
+
+    def rms_decay(self, decay: float) -> "Builder":
+        self._g.updater.rms_decay = float(decay)
+        return self
+
+    def adam_mean_decay(self, b1: float) -> "Builder":
+        self._g.updater.adam_mean_decay = float(b1)
+        return self
+
+    def adam_var_decay(self, b2: float) -> "Builder":
+        self._g.updater.adam_var_decay = float(b2)
+        return self
+
+    def rho(self, rho: float) -> "Builder":
+        self._g.updater.rho = float(rho)
+        return self
+
+    def epsilon(self, eps: float) -> "Builder":
+        self._g.updater.epsilon = float(eps)
+        return self
+
+    # -- layer defaults ------------------------------------------------------
+    def activation(self, name: str) -> "Builder":
+        self._g.activation = name.lower()
+        return self
+
+    def weight_init(self, scheme: str) -> "Builder":
+        self._g.weight_init = scheme.lower()
+        return self
+
+    def dist(self, dist: Distribution) -> "Builder":
+        self._g.dist = dist
+        self._g.weight_init = "distribution"
+        return self
+
+    def bias_init(self, value: float) -> "Builder":
+        self._g.bias_init = float(value)
+        return self
+
+    def drop_out(self, p: float) -> "Builder":
+        self._g.dropout = float(p)
+        return self
+
+    def regularization(self, flag: bool = True) -> "Builder":
+        # Reference gate for l1/l2; here l1/l2 > 0 implies regularization.
+        return self
+
+    def l1(self, value: float) -> "Builder":
+        self._g.l1 = float(value)
+        return self
+
+    def l2(self, value: float) -> "Builder":
+        self._g.l2 = float(value)
+        return self
+
+    def l1_bias(self, value: float) -> "Builder":
+        self._g.l1_bias = float(value)
+        return self
+
+    def l2_bias(self, value: float) -> "Builder":
+        self._g.l2_bias = float(value)
+        return self
+
+    def gradient_normalization(self, mode: str,
+                               threshold: float = 1.0) -> "Builder":
+        self._g.gradient_normalization = mode
+        self._g.gradient_normalization_threshold = float(threshold)
+        return self
+
+    # -- transition to layer list -------------------------------------------
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self._g)
+
+    def graph_builder(self):
+        """Start a ComputationGraph config (reference
+        ``ComputationGraphConfiguration.GraphBuilder``)."""
+        from .computation_graph import GraphBuilder
+        return GraphBuilder(self._g)
+
+    def build_global(self) -> GlobalConfig:
+        return self._g
+
+
+NeuralNetConfiguration.Builder = Builder
+
+
+class ListBuilder:
+    """Reference ``NeuralNetConfiguration.ListBuilder``: ordered layers +
+    optional input type, producing a ``MultiLayerConfiguration``."""
+
+    def __init__(self, global_conf: GlobalConfig):
+        self._mlc = MultiLayerConfiguration(conf=global_conf)
+
+    def layer(self, index_or_layer, layer: Optional[BaseLayerConfig] = None
+              ) -> "ListBuilder":
+        """``layer(conf)`` appends; ``layer(i, conf)`` sets position i
+        (reference signature)."""
+        if layer is None:
+            self._mlc.layers.append(index_or_layer)
+        else:
+            idx = int(index_or_layer)
+            while len(self._mlc.layers) <= idx:
+                self._mlc.layers.append(None)  # type: ignore
+            self._mlc.layers[idx] = layer
+        return self
+
+    def input_preprocessor(self, index: int, pp) -> "ListBuilder":
+        self._mlc.input_preprocessors[int(index)] = pp
+        return self
+
+    def backprop(self, flag: bool) -> "ListBuilder":
+        self._mlc.backprop = flag
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._mlc.pretrain = flag
+        return self
+
+    def backprop_type(self, kind: str) -> "ListBuilder":
+        self._mlc.backprop_type = kind.lower()
+        return self
+
+    def t_bptt_forward_length(self, n: int) -> "ListBuilder":
+        self._mlc.tbptt_fwd_length = int(n)
+        return self
+
+    def t_bptt_backward_length(self, n: int) -> "ListBuilder":
+        self._mlc.tbptt_back_length = int(n)
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._mlc.input_type = input_type
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        mlc = self._mlc
+        if any(l is None for l in mlc.layers):
+            raise ValueError("Gaps in layer list (layer(i, ...) skipped an index)")
+        defaults = mlc.conf.layer_defaults()
+        for layer in mlc.layers:
+            layer.finalize_defaults(defaults)
+        if mlc.input_type is not None:
+            _infer_shapes(mlc)
+        return mlc
+
+
+def _layer_input_kind(layer: BaseLayerConfig) -> str:
+    """Which activation family the layer consumes: ff | cnn | rnn | any."""
+    return getattr(layer, "INPUT_KIND", "ff")
+
+
+def _infer_shapes(mlc: MultiLayerConfiguration) -> None:
+    """Walk the layer list, auto-inserting preprocessors at family boundaries
+    and setting each layer's n_in (reference ``ListBuilder.build`` calling
+    ``InputType`` inference + ``getPreProcessorForInputType``)."""
+    current = mlc.input_type
+    for i, layer in enumerate(mlc.layers):
+        if i not in mlc.input_preprocessors:
+            pp = _preprocessor_for(current, _layer_input_kind(layer))
+            if pp is not None:
+                mlc.input_preprocessors[i] = pp
+        if i in mlc.input_preprocessors:
+            current = mlc.input_preprocessors[i].output_type(current)
+        layer.set_n_in(current)
+        current = layer.output_type(current)
+
+
+def _preprocessor_for(input_type: InputType, want: str):
+    """Pick the adapter between an incoming InputType and a layer family
+    (reference per-InputType ``getPreProcessorForInputType...``)."""
+    kind = input_type.kind
+    if want == "any" or kind == want:
+        return None
+    if kind == "cnn_flat":
+        if want == "cnn":
+            return _pp.FlatToCnnPreProcessor(
+                input_type.height, input_type.width, input_type.channels)
+        if want == "ff":
+            return None  # already flat rows
+    if kind == "cnn" and want == "ff":
+        return _pp.CnnToFeedForwardPreProcessor(
+            input_type.height, input_type.width, input_type.channels)
+    if kind == "ff" and want == "cnn":
+        raise ValueError(
+            "Cannot infer H/W/C for ff->cnn; add FeedForwardToCnnPreProcessor "
+            "explicitly via input_preprocessor()")
+    if kind == "recurrent" and want == "ff":
+        return _pp.RnnToFeedForwardPreProcessor()
+    if kind == "ff" and want == "rnn":
+        return _pp.FeedForwardToRnnPreProcessor()
+    if kind == "cnn" and want == "rnn":
+        return _pp.CnnToRnnPreProcessor()
+    if kind == "recurrent" and want == "cnn":
+        raise ValueError(
+            "Cannot infer H/W/C for rnn->cnn; add RnnToCnnPreProcessor "
+            "explicitly")
+    raise ValueError(f"No preprocessor from {kind} to {want}")

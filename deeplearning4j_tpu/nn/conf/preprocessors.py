@@ -1,0 +1,174 @@
+"""Input preprocessors: shape adapters between layer families.
+
+TPU-native equivalent of the reference's ``nn/conf/preprocessor/`` (13
+classes — CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+CnnToRnnPreProcessor, RnnToCnnPreProcessor, ReshapePreProcessor, ...).
+
+In the reference each preprocessor implements both ``preProcess`` (forward)
+and ``backprop`` (reverse reshape of epsilons); here only the forward reshape
+is needed — reshapes are differentiable and XLA treats them as free layout
+ops.  Layouts are TPU-first: CNN activations are NHWC, RNN activations are
+(batch, time, features).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import inputs as _inputs
+from . import serde
+
+Array = jax.Array
+InputType = _inputs.InputType
+
+
+@dataclasses.dataclass
+class BasePreProcessor:
+    def __call__(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+
+@serde.register("cnn_to_ff")
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(BasePreProcessor):
+    """(batch, H, W, C) -> (batch, H*W*C).  Reference
+    ``CnnToFeedForwardPreProcessor`` (which flattens NCHW; layout differs but
+    the flat size and semantics match)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.feed_forward(input_type.flat_size())
+
+
+@serde.register("ff_to_cnn")
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(BasePreProcessor):
+    """(batch, H*W*C) -> (batch, H, W, C)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.convolutional(self.height, self.width, self.channels)
+
+
+@serde.register("rnn_to_ff")
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(BasePreProcessor):
+    """(batch, time, features) -> (batch*time, features).
+
+    Reference ``RnnToFeedForwardPreProcessor`` flattens the time axis so
+    dense layers apply per-timestep; the inverse restores it.
+    """
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.feed_forward(input_type.flat_size())
+
+
+@serde.register("ff_to_rnn")
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(BasePreProcessor):
+    """(batch*time, features) -> (batch, time, features); ``timesteps`` must
+    be known (set at network input or carried through)."""
+
+    timesteps: int = -1
+
+    def __call__(self, x: Array) -> Array:
+        if self.timesteps <= 0:
+            raise ValueError("FeedForwardToRnnPreProcessor needs timesteps")
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.recurrent(input_type.flat_size(), self.timesteps)
+
+
+@serde.register("cnn_to_rnn")
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(BasePreProcessor):
+    """(batch*time, H, W, C) -> (batch, time, H*W*C) (reference
+    ``CnnToRnnPreProcessor``)."""
+
+    timesteps: int = -1
+
+    def __call__(self, x: Array) -> Array:
+        feat = x.shape[1] * x.shape[2] * x.shape[3]
+        return x.reshape(-1, self.timesteps, feat)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.recurrent(input_type.flat_size(), self.timesteps)
+
+
+@serde.register("rnn_to_cnn")
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(BasePreProcessor):
+    """(batch, time, H*W*C) -> (batch*time, H, W, C)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.convolutional(self.height, self.width, self.channels)
+
+
+@serde.register("reshape")
+@dataclasses.dataclass
+class ReshapePreProcessor(BasePreProcessor):
+    """Arbitrary reshape keeping the batch axis (reference
+    ``ReshapePreProcessor``); ``shape`` excludes the batch dim."""
+
+    shape: tuple = ()
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_type(self, input_type: InputType) -> InputType:
+        shape = tuple(self.shape)
+        if len(shape) == 1:
+            return _inputs.feed_forward(shape[0])
+        if len(shape) == 2:
+            return _inputs.recurrent(shape[1], shape[0])
+        if len(shape) == 3:
+            return _inputs.convolutional(*shape)
+        raise ValueError(f"Cannot infer InputType from shape {shape}")
+
+
+@serde.register("flat_to_cnn")
+@dataclasses.dataclass
+class FlatToCnnPreProcessor(BasePreProcessor):
+    """(batch, H*W*C) flat image rows -> NHWC, for ``convolutionalFlat``
+    inputs (reference handles this inside ``FeedForwardToCnnPreProcessor``
+    when built from ``InputType.convolutionalFlat``)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x: Array) -> Array:
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.convolutional(self.height, self.width, self.channels)

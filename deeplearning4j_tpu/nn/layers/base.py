@@ -1,0 +1,153 @@
+"""Base layer contract.
+
+TPU-native equivalent of the reference's ``nn/api/Layer.java:37`` +
+``nn/conf/layers/Layer.java`` pair.  The reference splits declarative config
+(Jackson POJO) from the imperative layer object holding param views; here the
+two merge into one dataclass: serializable hyperparameters plus pure
+functions ``init_params`` / ``forward``.  Backprop (the reference's
+``backpropGradient``) is not hand-written — the whole network forward composes
+into one differentiable function and ``jax.grad`` supplies exact gradients,
+compiled with the forward into a single XLA program.
+
+State (e.g. batch-norm running statistics) is threaded explicitly:
+``forward(params, state, x, train, rng) -> (out, new_state)``, keeping every
+layer jit/pjit/scan-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _activations
+from ..updaters import UpdaterConfig
+from ..weights import Distribution, init_weights
+from ..conf import inputs as _inputs
+
+Array = jax.Array
+ParamTree = Dict[str, Array]
+StateTree = Dict[str, Array]
+InputType = _inputs.InputType
+
+
+@dataclasses.dataclass
+class BaseLayerConfig:
+    """Hyperparameters shared by every layer (reference
+    ``nn/conf/layers/Layer.java`` fields + per-layer overrides of the global
+    ``NeuralNetConfiguration.Builder`` values, builder methods at
+    ``NeuralNetConfiguration.java:521-900``)."""
+
+    # ``None`` means "inherit the network-level default" — the reference
+    # clones global builder values into each layer conf unless the layer
+    # overrides them; ``finalize_defaults`` performs that resolution here.
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[Distribution] = None
+    bias_init: Optional[float] = None
+    dropout: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    updater: Optional[UpdaterConfig] = None  # None -> network default
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+
+    _INHERITABLE = ("activation", "weight_init", "dist", "bias_init",
+                    "dropout", "l1", "l2", "l1_bias", "l2_bias", "updater",
+                    "gradient_normalization")
+
+    def finalize_defaults(self, defaults: "Dict[str, object]") -> None:
+        """Fill unset (None) inheritable fields from network-level defaults."""
+        for field in self._INHERITABLE:
+            if getattr(self, field, None) is None and field in defaults:
+                setattr(self, field, defaults[field])
+
+    # ---- shape inference -------------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def set_n_in(self, input_type: InputType) -> None:
+        """Infer and set n_in from the incoming InputType (no-op for layers
+        without explicit fan-in)."""
+
+    # ---- params / state --------------------------------------------------
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        return {}
+
+    def init_state(self) -> StateTree:
+        return {}
+
+    def param_order(self) -> tuple[str, ...]:
+        """Deterministic param ordering inside the flat parameter vector
+        (the reference's ParamInitializer layout, e.g. W then b —
+        ``nn/params/DefaultParamInitializer.java``)."""
+        return ()
+
+    # ---- forward ---------------------------------------------------------
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng: Optional[jax.Array] = None,
+                mask: Optional[Array] = None) -> Tuple[Array, StateTree]:
+        raise NotImplementedError
+
+    # ---- regularization wiring ------------------------------------------
+    def l1_by_param(self) -> Dict[str, float]:
+        out = {}
+        for k in self.param_order():
+            out[k] = (self.l1_bias if k == "b" else self.l1) or 0.0
+        return out
+
+    def l2_by_param(self) -> Dict[str, float]:
+        out = {}
+        for k in self.param_order():
+            out[k] = (self.l2_bias if k == "b" else self.l2) or 0.0
+        return out
+
+    # ---- helpers ---------------------------------------------------------
+    def _activate(self, z: Array) -> Array:
+        return _activations.get(self.activation)(z)
+
+    def apply_dropout(self, x: Array, train: bool,
+                      rng: Optional[jax.Array]) -> Array:
+        """Inverted dropout on the layer *input* during training (reference
+        ``BaseLayer.applyDropOutIfNecessary:486`` / ``util/Dropout.java``)."""
+        if not train or not self.dropout or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(
+                f"Layer {self.name or type(self).__name__}: dropout requires "
+                "an rng key at training time")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+@dataclasses.dataclass
+class FeedForwardLayerConfig(BaseLayerConfig):
+    """Base for layers with explicit n_in/n_out (reference
+    ``nn/conf/layers/FeedForwardLayer.java``)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _inputs.feed_forward(self.n_out)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in <= 0:
+            self.n_in = input_type.flat_size()
+
+    def param_order(self) -> tuple[str, ...]:
+        return ("W", "b")
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": init_weights(kw, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init or 0.0, dtype),
+        }

@@ -1,0 +1,152 @@
+"""Core feed-forward layers: Dense, Output, Loss, Activation, Dropout,
+Embedding.
+
+Equivalents of the reference configs in ``nn/conf/layers/`` (DenseLayer,
+OutputLayer, LossLayer, ActivationLayer, DropoutLayer, EmbeddingLayer) and
+their impls under ``nn/layers/feedforward/`` + ``nn/layers/BaseLayer.java``
+(generic ``W·x + b`` preOutput at ``BaseLayer.java:356``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import lossfunctions as _losses
+from ..conf import inputs as _inputs
+from ..conf import serde
+from .base import (Array, BaseLayerConfig, FeedForwardLayerConfig, ParamTree,
+                   StateTree)
+
+InputType = _inputs.InputType
+
+
+@serde.register("dense")
+@dataclasses.dataclass
+class DenseLayer(FeedForwardLayerConfig):
+    """Fully connected layer (reference ``nn/conf/layers/DenseLayer.java`` /
+    ``nn/layers/feedforward/dense/DenseLayer.java``).
+
+    Forward: ``activation(x @ W + b)`` — one MXU matmul; the activation fuses
+    into the same XLA computation.
+    """
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        x = self.apply_dropout(x, train, rng)
+        z = x @ params["W"] + params["b"]
+        return self._activate(z), state
+
+
+@serde.register("output")
+@dataclasses.dataclass
+class OutputLayer(FeedForwardLayerConfig):
+    """Dense + loss head (reference ``nn/conf/layers/OutputLayer.java`` /
+    ``nn/layers/OutputLayer.java``).  ``activation`` defaults to softmax with
+    MCXENT loss, matching the reference defaults."""
+
+    activation: str = "softmax"
+    loss: str = "mcxent"
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        x = self.apply_dropout(x, train, rng)
+        z = x @ params["W"] + params["b"]
+        return self._activate(z), state
+
+    def pre_output(self, params: ParamTree, x: Array) -> Array:
+        return x @ params["W"] + params["b"]
+
+    def compute_score(self, labels: Array, preout: Array,
+                      mask: Optional[Array] = None,
+                      average: bool = True) -> Array:
+        return _losses.score(self.loss, labels, preout, self.activation,
+                             mask, average)
+
+
+@serde.register("loss")
+@dataclasses.dataclass
+class LossLayer(BaseLayerConfig):
+    """Loss-only layer with no params (reference
+    ``nn/conf/layers/LossLayer.java`` / ``nn/layers/LossLayer.java``)."""
+
+    activation: str = "identity"
+    loss: str = "mse"
+
+    INPUT_KIND = "any"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        return self._activate(x), state
+
+    def pre_output(self, params: ParamTree, x: Array) -> Array:
+        return x
+
+    def compute_score(self, labels: Array, preout: Array,
+                      mask: Optional[Array] = None,
+                      average: bool = True) -> Array:
+        return _losses.score(self.loss, labels, preout, self.activation,
+                             mask, average)
+
+
+@serde.register("activation")
+@dataclasses.dataclass
+class ActivationLayer(BaseLayerConfig):
+    """Standalone activation (reference ``nn/conf/layers/ActivationLayer.java``)."""
+
+    INPUT_KIND = "any"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        return self._activate(x), state
+
+
+@serde.register("dropout_layer")
+@dataclasses.dataclass
+class DropoutLayer(BaseLayerConfig):
+    """Standalone dropout (reference ``nn/conf/layers/DropoutLayer.java``);
+    identity at inference."""
+
+    activation: str = "identity"
+
+    INPUT_KIND = "any"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        return self._activate(self.apply_dropout(x, train, rng)), state
+
+
+@serde.register("embedding")
+@dataclasses.dataclass
+class EmbeddingLayer(FeedForwardLayerConfig):
+    """Index -> vector lookup (reference
+    ``nn/conf/layers/EmbeddingLayer.java`` /
+    ``nn/layers/feedforward/embedding/EmbeddingLayer.java``).
+
+    Input is an integer index array of shape ``(batch,)`` or ``(batch, 1)``
+    (the reference takes a column of indices).  The lookup is a gather — XLA
+    lowers it to an HBM-friendly dynamic-slice rather than the reference's
+    row-view copy.
+    """
+
+    activation: str = "identity"
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        z = params["W"][idx] + params["b"]
+        return self._activate(z), state

@@ -1,0 +1,232 @@
+"""Loss functions.
+
+TPU-native equivalent of ND4J's ``ILossFunction`` SPI (``computeScore`` /
+``computeGradient``).  The full set exercised by the reference's
+``LossFunctionGradientCheck`` (see SURVEY.md §2.10) is provided: MCXENT, XENT,
+NEGATIVELOGLIKELIHOOD, MSE, L1, L2, MAE, MAPE, MSLE, HINGE, SQUARED_HINGE,
+KL_DIVERGENCE, POISSON, COSINE_PROXIMITY.
+
+Each loss is a pure function ``loss(labels, preoutput, activation, mask) ->
+per-example scores`` of shape ``(batch,)``; gradients come from ``jax.grad`` of
+the mean, so there is no hand-written ``computeGradient`` twin.  Losses receive
+the *pre-activation* output plus the output-layer activation name so that
+numerically-fused forms (softmax+MCXENT -> log_softmax, sigmoid+XENT ->
+log_sigmoid) compile to stable XLA code — the reference achieves the same
+fusion inside ``LossMCXENT.computeGradient`` by special-casing softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import activations
+
+Array = jax.Array
+
+_EPS = 1e-7
+
+
+def _activate(preout: Array, activation: str) -> Array:
+    return activations.get(activation)(preout)
+
+
+def _reduce_features(per_element: Array) -> Array:
+    """Sum per-element scores over all non-batch axes -> (batch,)."""
+    axes = tuple(range(1, per_element.ndim))
+    return jnp.sum(per_element, axis=axes) if axes else per_element
+
+
+def mcxent(labels: Array, preout: Array, activation: str = "softmax",
+           mask: Optional[Array] = None) -> Array:
+    """Multi-class cross entropy: -sum(labels * log(p))."""
+    if activation == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(_activate(preout, activation), _EPS, 1.0))
+    per = -labels * logp
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def negativeloglikelihood(labels: Array, preout: Array,
+                          activation: str = "softmax",
+                          mask: Optional[Array] = None) -> Array:
+    """Alias of MCXENT in the reference (``LossNegativeLogLikelihood``)."""
+    return mcxent(labels, preout, activation, mask)
+
+
+def xent(labels: Array, preout: Array, activation: str = "sigmoid",
+         mask: Optional[Array] = None) -> Array:
+    """Binary cross entropy: -[y log p + (1-y) log(1-p)] per output unit."""
+    if activation == "sigmoid":
+        # log(sigmoid(x)) = -softplus(-x); log(1-sigmoid(x)) = -softplus(x)
+        logp = -jax.nn.softplus(-preout)
+        log1mp = -jax.nn.softplus(preout)
+    else:
+        p = jnp.clip(_activate(preout, activation), _EPS, 1.0 - _EPS)
+        logp, log1mp = jnp.log(p), jnp.log1p(-p)
+    per = -(labels * logp + (1.0 - labels) * log1mp)
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def mse(labels: Array, preout: Array, activation: str = "identity",
+        mask: Optional[Array] = None) -> Array:
+    """Mean squared error per example: mean over output units of (y-yhat)^2.
+
+    DL4J's ``LossMSE`` is ``LossL2 / nOut`` (average over the output dim).
+    """
+    return l2(labels, preout, activation, mask) / labels.shape[-1]
+
+
+def l2(labels: Array, preout: Array, activation: str = "identity",
+       mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    per = jnp.square(out - labels)
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def l1(labels: Array, preout: Array, activation: str = "identity",
+       mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    per = jnp.abs(out - labels)
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def mae(labels: Array, preout: Array, activation: str = "identity",
+        mask: Optional[Array] = None) -> Array:
+    """DL4J ``LossMAE`` = LossL1 / nOut."""
+    return l1(labels, preout, activation, mask) / labels.shape[-1]
+
+
+def mape(labels: Array, preout: Array, activation: str = "identity",
+         mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    per = 100.0 * jnp.abs((out - labels) / jnp.where(labels == 0, _EPS, labels))
+    per = _apply_mask(per, mask)
+    return _reduce_features(per) / labels.shape[-1]
+
+
+def msle(labels: Array, preout: Array, activation: str = "identity",
+         mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    per = jnp.square(jnp.log1p(out) - jnp.log1p(labels))
+    per = _apply_mask(per, mask)
+    return _reduce_features(per) / labels.shape[-1]
+
+
+def hinge(labels: Array, preout: Array, activation: str = "identity",
+          mask: Optional[Array] = None) -> Array:
+    """Hinge loss; labels expected in {-1, +1} (reference ``LossHinge``)."""
+    out = _activate(preout, activation)
+    per = jnp.maximum(0.0, 1.0 - labels * out)
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def squared_hinge(labels: Array, preout: Array, activation: str = "identity",
+                  mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    per = jnp.square(jnp.maximum(0.0, 1.0 - labels * out))
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def kl_divergence(labels: Array, preout: Array, activation: str = "softmax",
+                  mask: Optional[Array] = None) -> Array:
+    out = jnp.clip(_activate(preout, activation), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    per = labels * (jnp.log(lab) - jnp.log(out))
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def poisson(labels: Array, preout: Array, activation: str = "identity",
+            mask: Optional[Array] = None) -> Array:
+    out = jnp.clip(_activate(preout, activation), _EPS, None)
+    per = out - labels * jnp.log(out)
+    per = _apply_mask(per, mask)
+    return _reduce_features(per)
+
+
+def cosine_proximity(labels: Array, preout: Array, activation: str = "identity",
+                     mask: Optional[Array] = None) -> Array:
+    out = _activate(preout, activation)
+    if mask is not None:
+        out = _apply_mask(out, mask)
+        labels = _apply_mask(labels, mask)
+    dot = jnp.sum(labels * out, axis=-1)
+    norm = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+    sim = dot / jnp.clip(norm, _EPS, None)
+    if sim.ndim > 1:  # time-distributed: sum over remaining axes
+        sim = jnp.sum(sim, axis=tuple(range(1, sim.ndim)))
+    return -sim
+
+
+def _apply_mask(per: Array, mask: Optional[Array]) -> Array:
+    """Apply a per-example or per-timestep mask by broadcasting.
+
+    Reference masking semantics: score contributions of masked-out steps are
+    zeroed (``MaskedReductionUtil`` / ``BaseOutputLayer.applyMask``).
+    """
+    if mask is None:
+        return per
+    m = mask
+    while m.ndim < per.ndim:
+        m = m[..., None]
+    return per * m
+
+
+_LOSSES: dict[str, Callable[..., Array]] = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "xent": xent,
+    "mse": mse,
+    "l1": l1,
+    "l2": l2,
+    "mae": mae,
+    "mape": mape,
+    "msle": msle,
+    "hinge": hinge,
+    "squaredhinge": squared_hinge,
+    "squared_hinge": squared_hinge,
+    "kld": kl_divergence,
+    "kl_divergence": kl_divergence,
+    "poisson": poisson,
+    "cosineproximity": cosine_proximity,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get(name: str) -> Callable[..., Array]:
+    key = name.lower()
+    if key not in _LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(_LOSSES)}")
+    return _LOSSES[key]
+
+
+def score(name: str, labels: Array, preout: Array, activation: str,
+          mask: Optional[Array] = None, average: bool = True) -> Array:
+    """Scalar score for a minibatch (mean over examples when ``average``).
+
+    Matches ``BaseOutputLayer.computeScore`` -> ``ILossFunction.computeScore``.
+    """
+    per = get(name)(labels, preout, activation, mask)
+    total = jnp.sum(per)
+    if not average:
+        return total
+    if mask is not None and mask.ndim >= 2:
+        # Time-series masking: average over present timesteps (reference
+        # divides by number of unmasked elements via score array reduction).
+        denom = jnp.clip(jnp.sum(mask), 1.0, None)
+        return total / denom
+    return total / per.shape[0]
+
+
+def available() -> list[str]:
+    return sorted(_LOSSES)

@@ -1,0 +1,374 @@
+"""MultiLayerNetwork: sequential network container.
+
+TPU-native equivalent of the reference's
+``nn/multilayer/MultiLayerNetwork.java`` (2527 LoC): ``init():384-470``
+(flat params + per-layer views), ``fit(DataSetIterator):976``,
+``computeGradientAndScore:1805``, ``output:1519-1601``, ``score:1705``.
+
+Architecture: the reference materializes layer objects holding views over one
+flat parameter buffer, then drives per-layer ``activate``/``backpropGradient``
+loops from a ``Solver``.  Here the entire inner loop — forward, loss,
+backward (``jax.grad``), updater — is ONE jitted function, so XLA compiles
+the whole train step into a single HLO graph executed on the TPU (the north
+star in BASELINE.json).  Params/updater-state are pytrees; ``params()``
+exposes the reference's flat-vector invariant via deterministic raveling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import updaters as _updaters
+from .conf.neural_net_configuration import MultiLayerConfiguration
+from ..datasets.dataset import DataSet
+
+Array = jax.Array
+
+
+class MultiLayerNetwork:
+    """Sequential model: list of layer configs -> pure train/inference fns."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: List[Dict[str, Array]] = []
+        self.net_state: List[Dict[str, Array]] = []
+        self.updater_state: List[Dict[str, Any]] = []
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self._init_done = False
+        self._score = float("nan")
+        self._rng_key: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "MultiLayerNetwork":
+        """Initialize params/state (reference ``init():384-470``)."""
+        if self._init_done:
+            return self
+        dtype = jnp.dtype(self.conf.conf.dtype)
+        key = jax.random.PRNGKey(self.conf.conf.seed)
+        self._rng_key = key
+        keys = jax.random.split(key, len(self.layers) + 1)
+        self.params = [
+            layer.init_params(keys[i], dtype)
+            for i, layer in enumerate(self.layers)
+        ]
+        self.net_state = [layer.init_state() for layer in self.layers]
+        self.updater_state = [
+            _updaters.init_state(self._updater_conf(i), self.params[i])
+            for i in range(len(self.layers))
+        ]
+        self._init_done = True
+        return self
+
+    def _updater_conf(self, i: int) -> _updaters.UpdaterConfig:
+        return self.layers[i].updater or self.conf.conf.updater
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, net_state, x, *, train: bool,
+                 rng: Optional[jax.Array], mask=None,
+                 to_layer: Optional[int] = None,
+                 preoutput_last: bool = False):
+        """Compose preprocessors + layers (reference ``feedForwardToLayer``).
+
+        Returns (out, new_state).  With ``preoutput_last`` the final (output)
+        layer contributes its pre-activation, letting the loss fuse
+        softmax/sigmoid stably.
+        """
+        n = len(self.layers) if to_layer is None else to_layer + 1
+        new_state = list(net_state)
+        keys = (jax.random.split(rng, n) if rng is not None else [None] * n)
+        compute_dtype = self.conf.conf.compute_dtype
+        if compute_dtype:
+            x = x.astype(jnp.dtype(compute_dtype))
+        for i in range(n):
+            layer = self.layers[i]
+            if i in self.conf.input_preprocessors:
+                x = self.conf.input_preprocessors[i](x)
+            if preoutput_last and i == n - 1 and hasattr(layer, "pre_output"):
+                if layer.dropout and train:
+                    x = layer.apply_dropout(x, train, keys[i])
+                x = layer.pre_output(params[i], x)
+            else:
+                x, new_state[i] = layer.forward(
+                    params[i], net_state[i], x, train=train, rng=keys[i],
+                    mask=mask)
+        if compute_dtype:
+            x = x.astype(jnp.float32)
+        return x, new_state
+
+    # ----------------------------------------------------------------- loss
+    def _loss_fn(self, params, net_state, features, labels, labels_mask,
+                 rng, train: bool):
+        """Data loss (+ new state).  Regularization is handled updater-side
+        to match the reference order of operations (SURVEY.md §7 hard part d);
+        the reported score adds the reg term separately
+        (``BaseLayer.calcL2``)."""
+        preout, new_state = self._forward(
+            params, net_state, features, train=train, rng=rng,
+            preoutput_last=True)
+        out_layer = self.layers[-1]
+        if not hasattr(out_layer, "compute_score"):
+            raise ValueError(
+                "Last layer must be an output/loss layer to fit()")
+        data_loss = out_layer.compute_score(labels, preout, labels_mask,
+                                            average=self.conf.conf.mini_batch)
+        return data_loss, new_state
+
+    def _reg_score(self, params) -> Array:
+        total = jnp.asarray(0.0, jnp.float32)
+        for i, layer in enumerate(self.layers):
+            total = total + _updaters.regularization_score(
+                params[i], layer.l1_by_param(), layer.l2_by_param())
+        return total
+
+    # ------------------------------------------------------------ train step
+    @functools.cached_property
+    def _train_step(self):
+        """Build the jitted train step: fwd + bwd + updater in one XLA
+        program.  Donation lets XLA update params/updater state in place in
+        HBM (the analogue of the reference's in-place flat-buffer step)."""
+
+        def step(params, updater_state, net_state, iteration, features,
+                 labels, labels_mask, base_rng):
+            rng = jax.random.fold_in(base_rng, iteration)
+            (data_loss, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, net_state, features, labels, labels_mask, rng,
+                    True)
+            new_params, new_updater_state = [], []
+            for i, layer in enumerate(self.layers):
+                uconf = self._updater_conf(i)
+                g = grads[i]
+                if g:
+                    g = _updaters.regularize(g, params[i], layer.l1_by_param(),
+                                             layer.l2_by_param())
+                    g = _updaters.normalize_gradients(
+                        g, layer.gradient_normalization,
+                        layer.gradient_normalization_threshold)
+                    updates, ustate = _updaters.compute_update(
+                        uconf, g, updater_state[i], iteration)
+                    new_params.append(jax.tree.map(
+                        lambda p, u: p - u, params[i], updates))
+                    new_updater_state.append(ustate)
+                else:
+                    new_params.append(params[i])
+                    new_updater_state.append(updater_state[i])
+            score = data_loss + self._reg_score(params)
+            return new_params, new_updater_state, new_state, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _score_fn(self):
+        def score(params, net_state, features, labels, labels_mask):
+            data_loss, _ = self._loss_fn(params, net_state, features, labels,
+                                         labels_mask, None, False)
+            return data_loss + self._reg_score(params)
+        return jax.jit(score)
+
+    @functools.cached_property
+    def _output_fn(self):
+        def run(params, net_state, features):
+            out, _ = self._forward(params, net_state, features, train=False,
+                                   rng=None)
+            return out
+        return jax.jit(run)
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
+        """Train (reference ``fit(DataSetIterator):976`` /
+        ``fit(INDArray,INDArray):1406``).
+
+        ``data`` may be a DataSetIterator-like iterable of :class:`DataSet`,
+        a single :class:`DataSet`, or a features array with ``labels``.
+        """
+        self.init()
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            batches: Sequence[DataSet] = [data]
+            iterator = None
+        else:
+            iterator = data
+            batches = None
+
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            it = batches if batches is not None else iterator
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in it:
+                self._fit_batch(ds)
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, ds: DataSet) -> None:
+        features = jnp.asarray(ds.features)
+        labels = jnp.asarray(ds.labels)
+        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        for _ in range(self.conf.conf.num_iterations):
+            (self.params, self.updater_state, self.net_state,
+             score) = self._train_step(
+                self.params, self.updater_state, self.net_state,
+                self.iteration, features, labels, lmask, self._rng_key)
+            self._score = score
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------- inference
+    def output(self, features, train: bool = False) -> np.ndarray:
+        """Forward pass (reference ``output:1519-1601``; TEST mode: no
+        dropout, BN running stats)."""
+        self.init()
+        out = self._output_fn(self.params, self.net_state,
+                              jnp.asarray(features))
+        return np.asarray(out)
+
+    def feed_forward(self, features) -> List[np.ndarray]:
+        """All layer activations (reference ``feedForward:655-747``)."""
+        self.init()
+        acts = []
+        x = jnp.asarray(features)
+        for i in range(len(self.layers)):
+            x, _ = self._forward(self.params, self.net_state,
+                                 jnp.asarray(features), train=False, rng=None,
+                                 to_layer=i)
+            acts.append(np.asarray(x))
+        return acts
+
+    def predict(self, features) -> np.ndarray:
+        """Argmax class predictions (reference ``predict``)."""
+        return np.argmax(self.output(features), axis=-1)
+
+    def score(self, dataset: Optional[DataSet] = None) -> float:
+        """Mean loss on a dataset (reference ``score:1705``)."""
+        if dataset is None:
+            return float(self._score)
+        self.init()
+        lmask = (None if dataset.labels_mask is None
+                 else jnp.asarray(dataset.labels_mask))
+        val = self._score_fn(self.params, self.net_state,
+                             jnp.asarray(dataset.features),
+                             jnp.asarray(dataset.labels), lmask)
+        return float(val)
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (reference
+        ``MultiLayerNetwork.evaluate``)."""
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        if isinstance(iterator, DataSet):
+            iterator = [iterator]
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), out)
+        return ev
+
+    # ------------------------------------------------ flat-param invariant
+    def param_table(self) -> Dict[str, np.ndarray]:
+        """Named params ``{"0_W": ..., "0_b": ...}`` (reference
+        ``paramTable()`` naming)."""
+        self.init()
+        out = {}
+        for i, layer in enumerate(self.layers):
+            for name in layer.param_order():
+                out[f"{i}_{name}"] = np.asarray(self.params[i][name])
+        return out
+
+    def num_params(self) -> int:
+        self.init()
+        return sum(int(np.prod(p.shape))
+                   for tree in self.params
+                   for p in jax.tree_util.tree_leaves(tree))
+
+    def get_flat_params(self) -> np.ndarray:
+        """One contiguous vector over all params in deterministic layer/param
+        order — the reference's single flat buffer (``init():396-470``)."""
+        self.init()
+        chunks = []
+        for i, layer in enumerate(self.layers):
+            for name in layer.param_order():
+                chunks.append(np.asarray(self.params[i][name]).ravel())
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_flat_params(self, flat: np.ndarray) -> None:
+        self.init()
+        flat = np.asarray(flat)
+        offset = 0
+        for i, layer in enumerate(self.layers):
+            for name in layer.param_order():
+                shape = self.params[i][name].shape
+                size = int(np.prod(shape))
+                self.params[i][name] = jnp.asarray(
+                    flat[offset:offset + size].reshape(shape),
+                    self.params[i][name].dtype)
+                offset += size
+        if offset != flat.size:
+            raise ValueError(
+                f"Flat param size mismatch: expected {offset}, got {flat.size}")
+
+    def get_flat_updater_state(self) -> np.ndarray:
+        """Updater state as one flat vector (reference
+        ``BaseUpdater.getStateViewArray`` -> ``updaterState.bin``)."""
+        self.init()
+        leaves = []
+        for tree in self.updater_state:
+            leaves.extend(np.asarray(l).ravel()
+                          for l in jax.tree_util.tree_leaves(tree))
+        if not leaves:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(leaves)
+
+    def set_flat_updater_state(self, flat: np.ndarray) -> None:
+        self.init()
+        flat = np.asarray(flat)
+        offset = 0
+        new_states = []
+        for tree in self.updater_state:
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            new_leaves = []
+            for leaf in leaves:
+                size = int(np.prod(leaf.shape))
+                new_leaves.append(jnp.asarray(
+                    flat[offset:offset + size].reshape(leaf.shape),
+                    leaf.dtype))
+                offset += size
+            new_states.append(jax.tree_util.tree_unflatten(treedef, new_leaves))
+        self.updater_state = new_states
+
+    # -------------------------------------------------------------- misc API
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def clone(self) -> "MultiLayerNetwork":
+        """Config+params copy (reference ``clone()``)."""
+        import copy
+        other = MultiLayerNetwork(copy.deepcopy(self.conf))
+        other.init()
+        # Materialize copies: the jitted train step donates the originals, so
+        # shared references would be invalidated by the next fit().
+        other.params = jax.tree.map(jnp.copy, self.params)
+        other.net_state = jax.tree.map(jnp.copy, self.net_state)
+        other.updater_state = jax.tree.map(jnp.copy, self.updater_state)
+        other.iteration = self.iteration
+        return other

@@ -1,0 +1,292 @@
+"""Updaters, learning-rate policies, and gradient normalization.
+
+TPU-native equivalent of the reference's ``nn/updater/LayerUpdater.java`` plus
+ND4J's ``GradientUpdater`` implementations (Sgd/Adam/AdaDelta/Nesterovs/
+RmsProp/AdaGrad/NoOp — reference ``LayerUpdater.java:240-270``).  The DL4J
+order of operations is reproduced exactly (reference ``BaseUpdater.update``):
+
+1. l1/l2 regularization added to the raw gradient per param
+   (``LayerUpdater.java:104``: ``gradient += l2 * param + l1 * sign(param)``)
+2. gradient normalization (``LayerUpdater.java:182-225``):
+   RenormalizeL2PerLayer / RenormalizeL2PerParamType /
+   ClipElementWiseAbsoluteValue / ClipL2PerLayer / ClipL2PerParamType
+3. learning-rate policy applied for the current iteration
+   (``LayerUpdater.java:135-154``)
+4. per-param updater transform producing the step that the step function
+   subtracts from the params in place.
+
+Everything is a pure function of ``(grads, params, state, iteration)`` so the
+whole update fuses into the jitted train step (one XLA program — the "single
+HLO graph" north star).  Updater state is a pytree mirroring the params,
+which flattens to the single contiguous ``updaterState.bin`` view for
+serialization parity (reference ``BaseUpdater.setStateViewArray:34-48``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .conf import serde as _serde
+
+Array = jax.Array
+ParamTree = Dict[str, Array]
+
+_EPS_ADAGRAD = 1e-6
+_EPS_ADAM = 1e-8
+_EPS_ADADELTA = 1e-6
+_EPS_RMSPROP = 1e-8
+
+
+@_serde.register("updater_conf", custom=True)
+@dataclasses.dataclass
+class UpdaterConfig:
+    """Serializable updater hyperparameters (subset of
+    ``NeuralNetConfiguration`` fields that feed ``LayerUpdater``)."""
+
+    updater: str = "sgd"              # sgd|adam|adadelta|nesterovs|rmsprop|adagrad|none
+    learning_rate: float = 0.1
+    # lr policy (reference LearningRatePolicy enum)
+    lr_policy: str = "none"           # none|exponential|inverse|step|poly|sigmoid|schedule
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_power: float = 1.0
+    lr_policy_steps: float = 1.0
+    max_num_iterations: int = 1       # for poly
+    lr_schedule: Optional[Dict[int, float]] = None  # iteration -> lr
+    # momentum (nesterovs)
+    momentum: float = 0.9
+    momentum_schedule: Optional[Dict[int, float]] = None
+    # adam
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    # rmsprop
+    rms_decay: float = 0.95
+    # adadelta
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        # JSON object keys are strings; keep schedules serializable
+        for k in ("lr_schedule", "momentum_schedule"):
+            if d[k] is not None:
+                d[k] = {str(i): v for i, v in d[k].items()}
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "UpdaterConfig":
+        d = dict(d)
+        for k in ("lr_schedule", "momentum_schedule"):
+            if d.get(k):
+                d[k] = {int(i): v for i, v in d[k].items()}
+        return UpdaterConfig(**d)
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate policies (reference LayerUpdater.applyLrDecayPolicy)
+# ---------------------------------------------------------------------------
+
+def learning_rate_for(conf: UpdaterConfig, iteration: Array) -> Array:
+    """Effective lr at ``iteration`` (traced scalar -> jit friendly)."""
+    lr = jnp.asarray(conf.learning_rate, jnp.float32)
+    it = jnp.asarray(iteration, jnp.float32)
+    policy = conf.lr_policy.lower()
+    if policy in ("none", ""):
+        return lr
+    decay = conf.lr_policy_decay_rate
+    if policy == "exponential":
+        return lr * jnp.power(decay, it)
+    if policy == "inverse":
+        return lr / jnp.power(1.0 + decay * it, conf.lr_policy_power)
+    if policy == "step":
+        return lr * jnp.power(decay, jnp.floor(it / conf.lr_policy_steps))
+    if policy == "torchstep":
+        # reference: every `steps` iterations multiply by decay
+        return lr * jnp.power(decay, jnp.floor(it / conf.lr_policy_steps))
+    if policy == "poly":
+        frac = jnp.clip(it / max(conf.max_num_iterations, 1), 0.0, 1.0)
+        return lr * jnp.power(1.0 - frac, conf.lr_policy_power)
+    if policy == "sigmoid":
+        return lr / (1.0 + jnp.exp(-decay * (it - conf.lr_policy_steps)))
+    if policy == "schedule":
+        # piecewise-constant: last schedule entry with key <= iteration wins
+        sched = sorted((conf.lr_schedule or {}).items())
+        out = lr
+        for step, value in sched:
+            out = jnp.where(it >= step, jnp.asarray(value, jnp.float32), out)
+        return out
+    raise ValueError(f"Unknown lr policy '{conf.lr_policy}'")
+
+
+def momentum_for(conf: UpdaterConfig, iteration: Array) -> Array:
+    mu = jnp.asarray(conf.momentum, jnp.float32)
+    if conf.momentum_schedule:
+        it = jnp.asarray(iteration, jnp.float32)
+        for step, value in sorted(conf.momentum_schedule.items()):
+            mu = jnp.where(it >= step, jnp.asarray(value, jnp.float32), mu)
+    return mu
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (reference LayerUpdater.java:182-225)
+# ---------------------------------------------------------------------------
+
+def normalize_gradients(grads: ParamTree, mode: Optional[str],
+                        threshold: float = 1.0) -> ParamTree:
+    """Apply a DL4J ``GradientNormalization`` mode over one layer's grads."""
+    if not mode or mode.lower() in ("none",):
+        return grads
+    mode = mode.lower()
+    leaves = jax.tree_util.tree_leaves(grads)
+    if mode == "renormalizel2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = 1.0 / jnp.clip(norm, 1e-12, None)
+        return jax.tree.map(lambda g: g * scale, grads)
+    if mode == "renormalizel2perparamtype":
+        return jax.tree.map(
+            lambda g: g / jnp.clip(jnp.linalg.norm(g.ravel()), 1e-12, None),
+            grads)
+    if mode == "clipelementwiseabsolutevalue":
+        return jax.tree.map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == "clipl2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.where(norm > threshold, threshold / norm, 1.0)
+        return jax.tree.map(lambda g: g * scale, grads)
+    if mode == "clipl2perparamtype":
+        def clip_one(g):
+            norm = jnp.linalg.norm(g.ravel())
+            return g * jnp.where(norm > threshold, threshold / norm, 1.0)
+        return jax.tree.map(clip_one, grads)
+    raise ValueError(f"Unknown gradient normalization '{mode}'")
+
+
+# ---------------------------------------------------------------------------
+# Per-param updaters (ND4J GradientUpdater equivalents)
+# ---------------------------------------------------------------------------
+
+def init_state(conf: UpdaterConfig, params: ParamTree) -> ParamTree:
+    """Zero-initialized updater state mirroring the param tree.
+
+    Mirrors ND4J ``BaseUpdater`` state layout: adam keeps (m, v), nesterovs
+    keeps velocity, adagrad keeps historical sum, etc.  State for stateless
+    updaters is an empty tuple so the pytree stays jit-stable.
+    """
+    name = conf.updater.lower()
+    zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+    if name in ("sgd", "none", "noop"):
+        return {}
+    if name == "nesterovs":
+        return {"v": zeros()}
+    if name == "adagrad":
+        return {"h": zeros()}
+    if name == "rmsprop":
+        return {"cache": zeros()}
+    if name == "adam":
+        return {"m": zeros(), "v": zeros()}
+    if name == "adadelta":
+        return {"msg": zeros(), "msdx": zeros()}
+    raise ValueError(f"Unknown updater '{conf.updater}'")
+
+
+def compute_update(conf: UpdaterConfig, grads: ParamTree, state: ParamTree,
+                   iteration: Array) -> tuple[ParamTree, ParamTree]:
+    """Turn raw (regularized, normalized) grads into the step to subtract.
+
+    Returns ``(updates, new_state)``; caller does ``params -= updates``
+    (reference ``NegativeGradientStepFunction`` semantics).
+    """
+    name = conf.updater.lower()
+    lr = learning_rate_for(conf, iteration)
+
+    if name in ("none", "noop"):
+        return grads, state
+    if name == "sgd":
+        return jax.tree.map(lambda g: lr * g, grads), state
+    if name == "nesterovs":
+        mu = momentum_for(conf, iteration)
+        v_prev = state["v"]
+        v_new = jax.tree.map(lambda v, g: mu * v - lr * g, v_prev, grads)
+        # reference Nesterovs.getGradient: step = mu*vPrev - (1+mu)*vNew,
+        # subtracted from params by the step function
+        updates = jax.tree.map(
+            lambda vp, vn: mu * vp - (1.0 + mu) * vn, v_prev, v_new)
+        return updates, {"v": v_new}
+    if name == "adagrad":
+        h_new = jax.tree.map(lambda h, g: h + jnp.square(g),
+                             state["h"], grads)
+        updates = jax.tree.map(
+            lambda g, h: lr * g / (jnp.sqrt(h) + _EPS_ADAGRAD), grads, h_new)
+        return updates, {"h": h_new}
+    if name == "rmsprop":
+        d = conf.rms_decay
+        cache = jax.tree.map(
+            lambda c, g: d * c + (1.0 - d) * jnp.square(g),
+            state["cache"], grads)
+        updates = jax.tree.map(
+            lambda g, c: lr * g / (jnp.sqrt(c) + _EPS_RMSPROP), grads, cache)
+        return updates, {"cache": cache}
+    if name == "adam":
+        b1, b2 = conf.adam_mean_decay, conf.adam_var_decay
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                         state["m"], grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g),
+                         state["v"], grads)
+        # bias-corrected step (reference Adam.getGradient)
+        alpha = lr * jnp.sqrt(1 - jnp.power(b2, t)) / (1 - jnp.power(b1, t))
+        updates = jax.tree.map(
+            lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + _EPS_ADAM), m, v)
+        return updates, {"m": m, "v": v}
+    if name == "adadelta":
+        rho, eps = conf.rho, conf.epsilon or _EPS_ADADELTA
+        msg = jax.tree.map(
+            lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+            state["msg"], grads)
+        updates = jax.tree.map(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, msg, state["msdx"])
+        msdx = jax.tree.map(
+            lambda d, u: rho * d + (1 - rho) * jnp.square(u),
+            state["msdx"], updates)
+        return updates, {"msg": msg, "msdx": msdx}
+    raise ValueError(f"Unknown updater '{conf.updater}'")
+
+
+def regularize(grads: ParamTree, params: ParamTree,
+               l1_by_param: Dict[str, float],
+               l2_by_param: Dict[str, float]) -> ParamTree:
+    """Add l1/l2 penalties to raw grads, per param name.
+
+    Reference ``LayerUpdater.postApply``: ``gradient += l2 * param`` and
+    ``gradient += l1 * sign(param)`` — applied to weights but not biases
+    unless bias regularization is configured (``getL1ByParam``).
+    """
+    out = {}
+    for k, g in grads.items():
+        l1 = l1_by_param.get(k, 0.0)
+        l2 = l2_by_param.get(k, 0.0)
+        if l2:
+            g = g + l2 * params[k]
+        if l1:
+            g = g + l1 * jnp.sign(params[k])
+        out[k] = g
+    return out
+
+
+def regularization_score(params: ParamTree, l1_by_param: Dict[str, float],
+                         l2_by_param: Dict[str, float]) -> Array:
+    """l1/l2 penalty term added to the loss score (reference
+    ``BaseLayer.calcL2``/``calcL1``: 0.5*l2*||w||^2 + l1*||w||_1)."""
+    total = jnp.asarray(0.0, jnp.float32)
+    for k, p in params.items():
+        l1 = l1_by_param.get(k, 0.0)
+        l2 = l2_by_param.get(k, 0.0)
+        if l2:
+            total = total + 0.5 * l2 * jnp.sum(jnp.square(p))
+        if l1:
+            total = total + l1 * jnp.sum(jnp.abs(p))
+    return total

@@ -1,0 +1,136 @@
+"""Weight initialization schemes and distributions.
+
+TPU-native equivalent of the reference's ``nn/weights/WeightInit.java`` /
+``WeightInitUtil.java`` and ``nn/conf/distribution/``.  Each scheme is a pure
+function of a JAX PRNG key, so replica initialization under SPMD is
+deterministic given the seed (the analogue of DL4J's shared ``Nd4j.getRandom``
+seed when ``ParallelWrapper`` clones a model per device).
+
+Shapes follow the JAX convention ``(fan_in, fan_out)`` for dense kernels and
+``(H, W, C_in, C_out)`` (HWIO) for conv kernels; fan computation mirrors
+``WeightInitUtil.initWeights``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .conf import serde as _serde
+
+Array = jax.Array
+
+
+@_serde.register("distribution", custom=True)
+@dataclasses.dataclass
+class Distribution:
+    """Config-serializable sampling distribution (``nn/conf/distribution/``).
+
+    kind: "normal" (mean/std), "uniform" (lower/upper), "binomial"
+    (n_trials/prob_success).
+    """
+
+    kind: str = "normal"
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n_trials: int = 1
+    prob_success: float = 0.5
+
+    def sample(self, rng: jax.Array, shape: Sequence[int],
+               dtype=jnp.float32) -> Array:
+        if self.kind == "normal" or self.kind == "gaussian":
+            return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+        if self.kind == "binomial":
+            return jax.random.binomial(
+                rng, self.n_trials, self.prob_success, shape).astype(dtype)
+        raise ValueError(f"Unknown distribution kind '{self.kind}'")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Distribution":
+        return Distribution(**d)
+
+
+def _fans(shape: Sequence[int]) -> tuple[float, float]:
+    """(fan_in, fan_out) for dense (I,O) or conv HWIO kernels.
+
+    Mirrors ``WeightInitUtil`` fan computation: for conv, receptive-field size
+    multiplies channel fans.
+    """
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    if len(shape) >= 3:
+        receptive = 1.0
+        for s in shape[:-2]:
+            receptive *= s
+        return receptive * shape[-2], receptive * shape[-1]
+    return float(shape[0]), float(shape[0])
+
+
+def init_weights(rng: jax.Array, shape: Sequence[int], scheme: str = "xavier",
+                 distribution: Optional[Distribution] = None,
+                 dtype=jnp.float32) -> Array:
+    """Initialize a weight tensor per a DL4J ``WeightInit`` scheme name.
+
+    Supported (case-insensitive): zero, ones, xavier, xavier_uniform,
+    xavier_fan_in, xavier_legacy, relu, relu_uniform, sigmoid_uniform,
+    uniform, lecun_normal, lecun_uniform, normal, distribution, identity,
+    var_scaling_* aliases.
+    """
+    scheme = scheme.lower()
+    fan_in, fan_out = _fans(shape)
+    shape = tuple(shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a Distribution")
+        return distribution.sample(rng, shape, dtype)
+    if scheme == "xavier":
+        # Gaussian with var = 2/(fanIn+fanOut) (WeightInitUtil XAVIER)
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if scheme == "xavier_uniform":
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if scheme == "xavier_legacy":
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(
+            1.0 / (fan_in + fan_out))
+    if scheme in ("relu", "he_normal"):
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+    if scheme in ("relu_uniform", "he_uniform"):
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "uniform":
+        # DL4J legacy UNIFORM: U(-a, a) with a = 1/sqrt(fanIn)
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "lecun_normal":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if scheme == "lecun_uniform":
+        a = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "normal":
+        return jax.random.normal(rng, shape, dtype)
+    raise ValueError(f"Unknown WeightInit scheme '{scheme}'")

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU mesh before any XLA
+client is created.
+
+This is the analogue of the reference's Spark ``local[N]`` / threaded
+ParallelWrapper test strategy (SURVEY.md §4): multi-device semantics are
+validated on one host by faking 8 XLA CPU devices.  Also enables x64 so the
+gradient-check suite can run central differences in double precision, like
+the reference's double-precision gradient checks.
+
+Note: the dev image's sitecustomize may register a TPU-tunnel PJRT plugin and
+force ``jax_platforms`` programmatically; ``jax.config.update`` below wins
+over that as long as it runs before the first backend client is created —
+hence this must stay at conftest import time, before any test imports compute
+code.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,121 @@
+"""Activation + loss function unit tests (analogue of ND4J's activation/loss
+coverage exercised by the reference's LossFunctionGradientCheck —
+reference deeplearning4j-core/src/test/.../gradientcheck/LossFunctionGradientCheck.java)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, lossfunctions
+
+
+ALL_ACTIVATIONS = activations.available()
+
+
+@pytest.mark.parametrize("name", ALL_ACTIVATIONS)
+def test_activation_shapes_and_finite(name):
+    x = jnp.linspace(-3, 3, 24).reshape(4, 6).astype(jnp.float32)
+    y = activations.get(name)(x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_softmax_rows_sum_to_one():
+    x = jnp.array(np.random.RandomState(0).randn(5, 7), jnp.float32)
+    y = activations.get("softmax")(x)
+    np.testing.assert_allclose(np.asarray(y.sum(-1)), np.ones(5), atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ALL_ACTIVATIONS)
+def test_activation_differentiable(name):
+    x = jnp.linspace(-2, 2, 8).astype(jnp.float32)
+    g = jax.grad(lambda v: activations.get(name)(v).sum())(x)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        activations.get("nope")
+
+
+CLASSIFICATION_LOSSES = ["mcxent", "negativeloglikelihood", "kld"]
+BINARY_LOSSES = ["xent"]
+REGRESSION_LOSSES = ["mse", "l1", "l2", "mae", "mape", "msle", "poisson",
+                     "cosineproximity"]
+MARGIN_LOSSES = ["hinge", "squaredhinge"]
+
+
+@pytest.mark.parametrize("name", CLASSIFICATION_LOSSES)
+def test_classification_loss_positive_and_zero_at_truth(name):
+    labels = jnp.eye(4, dtype=jnp.float32)
+    # very confident correct logits -> near-zero loss
+    good = 100.0 * labels - 50.0
+    per = lossfunctions.get(name)(labels, good, "softmax")
+    assert per.shape == (4,)
+    assert float(per.sum()) < 1e-3
+    bad = -100.0 * labels
+    assert float(lossfunctions.get(name)(labels, bad, "softmax").sum()) > 1.0
+
+
+@pytest.mark.parametrize("name", REGRESSION_LOSSES)
+def test_regression_loss_zero_at_truth(name):
+    rng = np.random.RandomState(3)
+    labels = jnp.asarray(np.abs(rng.randn(6, 5)) + 0.5, jnp.float32)
+    per = lossfunctions.get(name)(labels, labels, "identity")
+    assert per.shape == (6,)
+    if name == "cosineproximity":
+        np.testing.assert_allclose(np.asarray(per), -np.ones(6), atol=1e-5)
+    elif name == "poisson":
+        pass  # poisson loss is not zero at truth by definition
+    else:
+        np.testing.assert_allclose(np.asarray(per), np.zeros(6), atol=1e-5)
+
+
+def test_xent_matches_manual():
+    labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    logits = jnp.array([[2.0, -1.0], [0.5, 0.5]])
+    per = lossfunctions.xent(labels, logits, "sigmoid")
+    p = jax.nn.sigmoid(logits)
+    manual = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p)).sum(-1)
+    np.testing.assert_allclose(np.asarray(per), np.asarray(manual), atol=1e-5)
+
+
+def test_score_averages_over_batch():
+    labels = jnp.eye(4, dtype=jnp.float32)
+    preout = jnp.zeros((4, 4), jnp.float32)
+    total = lossfunctions.score("mcxent", labels, preout, "softmax",
+                                average=False)
+    mean = lossfunctions.score("mcxent", labels, preout, "softmax",
+                               average=True)
+    np.testing.assert_allclose(float(total) / 4.0, float(mean), atol=1e-6)
+
+
+def test_mask_zeroes_contribution():
+    labels = jnp.eye(3, dtype=jnp.float32)
+    preout = jnp.asarray(np.random.RandomState(0).randn(3, 3), jnp.float32)
+    mask = jnp.array([1.0, 0.0, 1.0])
+    per = lossfunctions.mcxent(labels, preout, "softmax", mask)
+    assert float(per[1]) == 0.0
+
+
+@pytest.mark.parametrize("name", CLASSIFICATION_LOSSES + BINARY_LOSSES
+                         + REGRESSION_LOSSES + MARGIN_LOSSES)
+def test_loss_differentiable(name):
+    rng = np.random.RandomState(1)
+    if name in MARGIN_LOSSES:
+        labels = jnp.asarray(np.sign(rng.randn(4, 3)), jnp.float32)
+        act = "identity"
+    elif name in BINARY_LOSSES:
+        labels = jnp.asarray((rng.rand(4, 3) > 0.5).astype(np.float32))
+        act = "sigmoid"
+    elif name in CLASSIFICATION_LOSSES:
+        labels = jnp.asarray(np.eye(3)[rng.randint(0, 3, 4)], jnp.float32)
+        act = "softmax"
+    else:
+        labels = jnp.asarray(np.abs(rng.randn(4, 3)) + 0.5, jnp.float32)
+        act = "identity"
+    preout = jnp.asarray(0.1 * rng.randn(4, 3), jnp.float32)
+    g = jax.grad(
+        lambda z: lossfunctions.score(name, labels, z, act))(preout)
+    assert bool(jnp.all(jnp.isfinite(g)))

@@ -1,0 +1,76 @@
+"""Gradient checks: the backbone test strategy of the reference
+(deeplearning4j-core/src/test/.../gradientcheck/GradientCheckTests.java).
+Every layer family x activation x loss gets numerical-vs-analytic validation
+in float64."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+
+def _ds(n=8, n_in=4, n_classes=3, seed=0, regression=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_in)
+    if regression:
+        Y = rng.randn(n, n_classes)
+    else:
+        Y = np.eye(n_classes)[rng.randint(0, n_classes, n)]
+    return DataSet(X, Y)
+
+
+def _net(layers, l1=0.0, l2=0.0):
+    b = (NeuralNetConfiguration.builder().seed(12345)
+         .dtype("float64")
+         .updater("sgd").learning_rate(0.1)
+         .l1(l1).l2(l2)
+         .weight_init("xavier"))
+    lb = b.list()
+    for l in layers:
+        lb.layer(l)
+    return MultiLayerNetwork(lb.build()).init()
+
+
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", "elu", "softplus",
+                                        "cube", "softsign", "rationaltanh"])
+def test_mlp_activations(activation):
+    net = _net([DenseLayer(n_in=4, n_out=6, activation=activation),
+                OutputLayer(n_in=6, n_out=3)])
+    assert check_gradients(net, _ds(), print_results=True)
+
+
+@pytest.mark.parametrize("loss,act,regression", [
+    ("mcxent", "softmax", False),
+    ("xent", "sigmoid", False),
+    ("mse", "identity", True),
+    ("mse", "tanh", True),
+    ("l2", "identity", True),
+    ("mae", "identity", True),
+    ("negativeloglikelihood", "softmax", False),
+])
+def test_output_losses(loss, act, regression):
+    ds = _ds(regression=regression)
+    if loss == "xent":
+        rng = np.random.RandomState(5)
+        ds = DataSet(ds.features,
+                     (rng.rand(8, 3) > 0.5).astype(np.float64))
+    net = _net([DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                OutputLayer(n_in=6, n_out=3, activation=act, loss=loss)])
+    assert check_gradients(net, ds, print_results=True)
+
+
+def test_l1_l2_regularization_gradients():
+    net = _net([DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                OutputLayer(n_in=6, n_out=3)], l1=0.01, l2=0.02)
+    assert check_gradients(net, _ds(), print_results=True)
+
+
+def test_deep_mlp():
+    net = _net([DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                DenseLayer(n_in=8, n_out=8, activation="sigmoid"),
+                DenseLayer(n_in=8, n_out=6, activation="elu"),
+                OutputLayer(n_in=6, n_out=3)])
+    assert check_gradients(net, _ds(), subset=60, print_results=True)

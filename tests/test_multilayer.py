@@ -1,0 +1,205 @@
+"""MultiLayerNetwork integration tests: fit/output/score/serde/flat-params
+(analogue of reference deeplearning4j-core/src/test/.../nn/multilayer/
+MultiLayerTest.java and nn/conf serde tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import (ActivationLayer, DenseLayer,
+                                               DropoutLayer, EmbeddingLayer,
+                                               LossLayer, OutputLayer)
+
+
+def _toy_classification(n=128, n_in=4, n_classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_in).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    Y = np.eye(n_classes, dtype=np.float32)[y]
+    return DataSet(X, Y)
+
+
+def _mlp_conf(updater="sgd", lr=0.5, **builder_kw):
+    b = (NeuralNetConfiguration.builder()
+         .seed(42).updater(updater).learning_rate(lr)
+         .activation("tanh").weight_init("xavier"))
+    return (b.list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+
+
+def test_n_in_inference():
+    conf = _mlp_conf()
+    assert conf.layers[0].n_in == 4
+    assert conf.layers[1].n_in == 16
+
+
+def test_global_defaults_inherited_and_overridable():
+    conf = (NeuralNetConfiguration.builder()
+            .activation("relu").l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    assert conf.layers[0].activation == "relu"
+    assert conf.layers[1].activation == "tanh"
+    assert conf.layers[2].activation == "softmax"  # OutputLayer default
+    assert conf.layers[0].l2 == 1e-4
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "nesterovs", "rmsprop",
+                                     "adagrad", "adadelta"])
+def test_fit_decreases_score_all_updaters(updater):
+    lr = {"sgd": 0.5, "adam": 0.01, "nesterovs": 0.1, "rmsprop": 0.01,
+          "adagrad": 0.1, "adadelta": 1.0}[updater]
+    ds = _toy_classification()
+    net = MultiLayerNetwork(_mlp_conf(updater=updater, lr=lr)).init()
+    s0 = net.score(ds)
+    for _ in range(100):
+        net.fit(ds)
+    assert net.score(ds) < s0
+
+
+def test_accuracy_on_separable_toy():
+    ds = _toy_classification()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    for _ in range(300):
+        net.fit(ds)
+    assert net.evaluate(ds).accuracy() > 0.95
+
+
+def test_output_deterministic_inference():
+    ds = _toy_classification()
+    conf = (NeuralNetConfiguration.builder().seed(1).drop_out(0.5)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out1 = net.output(ds.features)
+    out2 = net.output(ds.features)
+    np.testing.assert_allclose(out1, out2)  # no dropout at inference
+
+
+def test_json_roundtrip_preserves_behavior():
+    ds = _toy_classification()
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ds)
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    net2 = MultiLayerNetwork(conf2).init()
+    net2.set_flat_params(net.get_flat_params())
+    np.testing.assert_allclose(net2.output(ds.features),
+                               net.output(ds.features), atol=1e-6)
+
+
+def test_flat_params_roundtrip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.get_flat_params()
+    assert flat.size == net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+    flat2 = flat + 1.0
+    net.set_flat_params(flat2)
+    np.testing.assert_allclose(net.get_flat_params(), flat2, atol=1e-6)
+
+
+def test_flat_updater_state_roundtrip():
+    ds = _toy_classification()
+    net = MultiLayerNetwork(_mlp_conf(updater="adam", lr=0.01)).init()
+    net.fit(ds)
+    flat = net.get_flat_updater_state()
+    assert flat.size == 2 * net.num_params()  # adam m+v
+    net.set_flat_updater_state(flat * 0.5)
+    np.testing.assert_allclose(net.get_flat_updater_state(), flat * 0.5,
+                               atol=1e-6)
+
+
+def test_seed_reproducibility():
+    c1 = _mlp_conf()
+    c2 = _mlp_conf()
+    n1 = MultiLayerNetwork(c1).init()
+    n2 = MultiLayerNetwork(c2).init()
+    np.testing.assert_allclose(n1.get_flat_params(), n2.get_flat_params())
+
+
+def test_param_table_names():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    table = net.param_table()
+    assert set(table) == {"0_W", "0_b", "1_W", "1_b"}
+    assert table["0_W"].shape == (4, 16)
+
+
+def test_embedding_layer_lookup():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .list()
+            .layer(EmbeddingLayer(n_in=10, n_out=5))
+            .layer(OutputLayer(n_in=5, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    idx = np.array([[1], [3], [7]], np.int32)
+    out = net.output(idx)
+    assert out.shape == (3, 2)
+
+
+def test_activation_and_dropout_layers_pass_through():
+    conf = (NeuralNetConfiguration.builder().seed(0).activation("relu")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(ActivationLayer(activation="tanh"))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.output(np.zeros((2, 4), np.float32))
+    assert out.shape == (2, 3)
+    ds = _toy_classification()
+    net.fit(ds)  # trains with dropout rng
+
+
+def test_regression_mse_head():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 3).astype(np.float32)
+    W_true = rng.randn(3, 2).astype(np.float32)
+    Y = X @ W_true
+    conf = (NeuralNetConfiguration.builder().seed(0).updater("adam")
+            .learning_rate(0.05)
+            .list()
+            .layer(OutputLayer(n_in=3, n_out=2, activation="identity",
+                               loss="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(X, Y)
+    for _ in range(300):
+        net.fit(ds)
+    assert net.score(ds) < 1e-2
+
+
+def test_loss_layer_headless():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=3, activation="identity"))
+            .layer(LossLayer(loss="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(np.random.RandomState(0).randn(8, 4).astype(np.float32),
+                 np.random.RandomState(1).randn(8, 3).astype(np.float32))
+    s0 = net.score(ds)
+    for _ in range(50):
+        net.fit(ds)
+    assert net.score(ds) < s0
+
+
+def test_clone_independent():
+    ds = _toy_classification()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    other = net.clone()
+    net.fit(ds)
+    # clone unchanged by original's training
+    assert not np.allclose(net.get_flat_params(), other.get_flat_params())
