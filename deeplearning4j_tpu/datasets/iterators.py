@@ -1,0 +1,183 @@
+"""DataSet iterators: contracts + async prefetch.
+
+TPU-native equivalents of the reference's
+``datasets/iterator/AsyncDataSetIterator.java`` (background prefetch thread,
+queue of 2 — used by ``MultiLayerNetwork.fit:980``),
+``IteratorDataSetIterator``, ``ExistingDataSetIterator``,
+``MultipleEpochsIterator`` and the ``DataSetIterator`` contract consumed
+everywhere (SURVEY.md §2.10).
+
+The iterator protocol is Python's: ``__iter__``/``__next__`` plus DL4J-style
+``reset()``/``batch()``/``total_examples()``.  Host-side prefetch overlaps
+numpy batch assembly with device execution — the same pipelining the
+reference gets from its AsyncDataSetIterator, with the device transfer
+handled by JAX's async dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Base contract (reference ``DataSetIterator``)."""
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate minibatches from an in-memory list of examples (reference
+    ``ListDataSetIterator``)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int = 32,
+                 shuffle: bool = False, seed: int = 0):
+        self._ds = dataset
+        self._batch = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._order = np.arange(dataset.num_examples())
+        self._pos = 0
+        self.reset()
+
+    def reset(self) -> None:
+        if self._shuffle:
+            rng = np.random.RandomState(self._seed + self._epoch)
+            self._order = rng.permutation(self._ds.num_examples())
+        self._pos = 0
+        self._epoch += 1
+
+    def batch(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._ds.num_examples()
+
+    def __next__(self) -> DataSet:
+        if self._pos >= self._ds.num_examples():
+            raise StopIteration
+        idx = self._order[self._pos:self._pos + self._batch]
+        self._pos += self._batch
+
+        def _take(a):
+            return None if a is None else np.asarray(a)[idx]
+
+        return DataSet(*[_take(a) for a in self._ds.as_tuple()])
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap a plain iterable of DataSets (reference
+    ``ExistingDataSetIterator``)."""
+
+    def __init__(self, source: Iterable[DataSet]):
+        self._source = source
+        self._it: Optional[Iterator[DataSet]] = None
+
+    def reset(self) -> None:
+        self._it = iter(self._source)
+
+    def batch(self) -> int:
+        return -1
+
+    def __next__(self) -> DataSet:
+        if self._it is None:
+            self.reset()
+        return next(self._it)
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay an underlying iterator N times as one pass (reference
+    ``MultipleEpochsIterator``)."""
+
+    def __init__(self, epochs: int, underlying: DataSetIterator):
+        self._epochs = epochs
+        self._under = underlying
+        self._epoch = 0
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self._under.reset()
+
+    def batch(self) -> int:
+        return self._under.batch()
+
+    def __next__(self) -> DataSet:
+        try:
+            return next(self._under)
+        except StopIteration:
+            self._epoch += 1
+            if self._epoch >= self._epochs:
+                raise
+            self._under.reset()
+            return next(self._under)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference
+    ``AsyncDataSetIterator``: queue capacity 2, daemon thread)."""
+
+    _END = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+        self._under = underlying
+        self._size = queue_size
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _worker(self) -> None:
+        try:
+            for ds in iter(self._under.__next__, None):
+                self._queue.put(ds)
+        except StopIteration:
+            pass
+        except BaseException as e:  # surfaced on the consumer thread
+            self._error = e
+        finally:
+            self._queue.put(self._END)
+
+    def reset(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            # Drain so the producer can exit, then join.
+            while self._queue.get() is not self._END:
+                pass
+            self._thread.join()
+        self._under.reset()
+        self._queue = queue.Queue(maxsize=self._size)
+        self._error = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def batch(self) -> int:
+        return self._under.batch()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._thread is None:
+            self.reset()
+        item = self._queue.get()
+        if item is self._END:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item

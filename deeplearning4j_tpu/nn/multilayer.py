@@ -215,6 +215,7 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, ds: DataSet) -> None:
+        self.last_batch_size = ds.num_examples()
         features = jnp.asarray(ds.features)
         labels = jnp.asarray(ds.labels)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)

@@ -1,0 +1,116 @@
+"""Training listeners.
+
+TPU-native equivalents of the reference's ``optimize/api/IterationListener`` /
+``TrainingListener`` SPI and the impls in ``optimize/listeners/``:
+``ScoreIterationListener``, ``PerformanceListener`` (samples/sec + batches/sec
+at ``PerformanceListener.java:99-102``), ``CollectScoresIterationListener``,
+``ParamAndGradientIterationListener``.
+
+Listeners run on the host after each jitted step; the score is the only value
+fetched from device per iteration, so the hot path stays one XLA program
+(SURVEY.md §7 hard part f — listeners must stay off the hot path).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """Reference ``IterationListener`` contract."""
+
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class TrainingListener(IterationListener):
+    """Adds epoch/forward/backward hooks (reference ``TrainingListener``)."""
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def iteration_done(self, model, iteration: int) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference
+    ``ScoreIterationListener.java``)."""
+
+    def __init__(self, print_iterations: int = 10, out=None):
+        self.print_iterations = max(1, print_iterations)
+        self._out = out
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.print_iterations == 0:
+            msg = f"Score at iteration {iteration} is {model.score():.6f}"
+            if self._out is not None:
+                print(msg, file=self._out)
+            else:
+                logger.info(msg)
+
+
+class PerformanceListener(IterationListener):
+    """Throughput sampling (reference ``PerformanceListener.java:99-102``):
+    iteration time, samples/sec, batches/sec.  These are the numbers BASELINE
+    tracks (samples/sec/chip)."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False,
+                 out=None):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._out = out
+        self._last_time: Optional[float] = None
+        self._last_iter: Optional[int] = None
+        self.history: List[Tuple[int, float, float]] = []  # (iter, samples/s, batches/s)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0 and iters > 0:
+                batch_size = getattr(model, "last_batch_size", None)
+                batches_per_sec = iters / dt
+                samples_per_sec = (batches_per_sec * batch_size
+                                   if batch_size else float("nan"))
+                self.history.append((iteration, samples_per_sec,
+                                     batches_per_sec))
+                msg = (f"iteration {iteration}: {samples_per_sec:.1f} "
+                       f"samples/sec, {batches_per_sec:.2f} batches/sec")
+                if self.report_score:
+                    msg += f", score {model.score():.6f}"
+                if self._out is not None:
+                    print(msg, file=self._out)
+                else:
+                    logger.info(msg)
+        if iteration % self.frequency == 0:
+            self._last_time = now
+            self._last_iter = iteration
+
+    def average_samples_per_sec(self, skip: int = 1) -> float:
+        """Mean throughput, skipping the first ``skip`` samples (compile)."""
+        vals = [s for _, s, _ in self.history[skip:]]
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs (reference
+    ``CollectScoresIterationListener``)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))

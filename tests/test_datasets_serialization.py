@@ -1,0 +1,179 @@
+"""Dataset iterators, listeners, and ModelSerializer round-trip tests
+(analogues of reference core dataset/iterator tests + ModelSerializer tests).
+Exit test from SURVEY.md §7 stage 2: an MLP trains MNIST(-alike) to high
+accuracy and serializes/restores identically."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator, iris_dataset
+from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                   ExistingDataSetIterator,
+                                                   ListDataSetIterator,
+                                                   MultipleEpochsIterator)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners.listeners import (
+    CollectScoresIterationListener, PerformanceListener,
+    ScoreIterationListener)
+from deeplearning4j_tpu.utils import model_serializer
+
+
+def test_list_iterator_batches_and_reset():
+    ds = DataSet(np.arange(20).reshape(10, 2).astype(np.float32),
+                 np.eye(10, dtype=np.float32))
+    it = ListDataSetIterator(ds, batch_size=3)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [3, 3, 3, 1]
+    sizes2 = [b.num_examples() for b in it]  # auto-reset on __iter__
+    assert sizes2 == sizes
+
+
+def test_list_iterator_shuffles_between_epochs():
+    ds = DataSet(np.arange(10, dtype=np.float32).reshape(10, 1),
+                 np.eye(10, dtype=np.float32))
+    it = ListDataSetIterator(ds, batch_size=10, shuffle=True, seed=0)
+    first = next(iter(it)).features.ravel().tolist()
+    second = next(iter(it)).features.ravel().tolist()
+    assert sorted(first) == sorted(second)
+    assert first != second  # reshuffled per epoch
+
+
+def test_multiple_epochs_iterator():
+    ds = DataSet(np.zeros((4, 1), np.float32), np.zeros((4, 2), np.float32))
+    it = MultipleEpochsIterator(3, ListDataSetIterator(ds, batch_size=2))
+    assert len(list(it)) == 6
+
+
+def test_existing_iterator():
+    batches = [DataSet(np.zeros((2, 1), np.float32),
+                       np.zeros((2, 2), np.float32))] * 3
+    it = ExistingDataSetIterator(batches)
+    assert len(list(it)) == 3
+    assert len(list(it)) == 3
+
+
+def test_async_iterator_matches_sync():
+    ds = DataSet(np.arange(12, dtype=np.float32).reshape(12, 1),
+                 np.eye(12, dtype=np.float32))
+    sync = ListDataSetIterator(ds, batch_size=5)
+    async_it = AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=5))
+    a = [b.features.ravel().tolist() for b in sync]
+    b = [b.features.ravel().tolist() for b in async_it]
+    assert a == b
+    b2 = [x.features.ravel().tolist() for x in async_it]  # re-iterable
+    assert b2 == a
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(50)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    assert batches[0].labels.shape == (50, 3)
+
+
+def test_mnist_iterator_shapes():
+    it = MnistDataSetIterator(32, 64, seed=1)
+    b = next(iter(it))
+    assert b.features.shape == (32, 784)
+    assert b.labels.shape == (32, 10)
+    assert 0.0 <= b.features.min() and b.features.max() <= 1.0
+    assert np.all(b.labels.sum(1) == 1.0)
+
+
+def test_mnist_deterministic_given_seed():
+    a = next(iter(MnistDataSetIterator(16, 16, shuffle=False, seed=3)))
+    b = next(iter(MnistDataSetIterator(16, 16, shuffle=False, seed=3)))
+    np.testing.assert_allclose(a.features, b.features)
+
+
+def test_mnist_binarize():
+    b = next(iter(MnistDataSetIterator(16, 16, binarize=True)))
+    assert set(np.unique(b.features)).issubset({0.0, 1.0})
+
+
+def _iris_mlp(updater="adam", lr=0.02):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(updater).learning_rate(lr)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+
+
+def test_listeners_fire():
+    buf = io.StringIO()
+    net = MultiLayerNetwork(_iris_mlp()).init()
+    score_l = ScoreIterationListener(1, out=buf)
+    perf_l = PerformanceListener(1, out=buf)
+    collect_l = CollectScoresIterationListener()
+    net.set_listeners(score_l, perf_l, collect_l)
+    it = IrisDataSetIterator(50)
+    net.fit(it, epochs=2)
+    assert len(collect_l.scores) == 6  # 3 batches x 2 epochs
+    assert "Score at iteration" in buf.getvalue()
+    assert len(perf_l.history) >= 1
+    assert perf_l.history[-1][1] > 0  # samples/sec positive
+
+
+def test_iris_trains_to_high_accuracy():
+    net = MultiLayerNetwork(_iris_mlp()).init()
+    it = IrisDataSetIterator(150)
+    net.fit(it, epochs=200)
+    ev = net.evaluate(iris_dataset())
+    assert ev.accuracy() > 0.95
+
+
+def test_serializer_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "model.zip")
+    net = MultiLayerNetwork(_iris_mlp()).init()
+    net.fit(IrisDataSetIterator(150), epochs=5)
+    model_serializer.write_model(net, path)
+    restored = model_serializer.restore_multi_layer_network(path)
+    X = iris_dataset().features
+    np.testing.assert_allclose(restored.output(X), net.output(X), atol=1e-6)
+    np.testing.assert_allclose(restored.get_flat_updater_state(),
+                               net.get_flat_updater_state(), atol=1e-6)
+    assert restored.iteration == net.iteration
+    # continues training from restored updater state without blowup
+    restored.fit(IrisDataSetIterator(150), epochs=1)
+
+
+def test_serializer_zip_entries(tmp_path):
+    import zipfile
+    path = os.path.join(tmp_path, "model.zip")
+    net = MultiLayerNetwork(_iris_mlp()).init()
+    model_serializer.write_model(net, path)
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+    # reference layout: configuration.json + coefficients.bin + updaterState.bin
+    assert {"configuration.json", "coefficients.bin",
+            "updaterState.bin"} <= names
+
+
+@pytest.mark.slow
+def test_mnist_mlp_exit_test():
+    """SURVEY.md §7 stage-2 exit test: MLP trains MNIST(-alike) to >97%."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123).updater("adam").learning_rate(1e-3)
+            .activation("relu").weight_init("relu")
+            .list()
+            .layer(DenseLayer(n_out=256))
+            .layer(DenseLayer(n_out=128))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(inputs.feed_forward(784))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    train = MnistDataSetIterator(128, 4096, seed=1, shuffle=True)
+    test = MnistDataSetIterator(256, 1024, train=False, seed=1)
+    net.fit(train, epochs=6)
+    acc = sum(net.evaluate(b).accuracy() for b in test) / 4
+    assert acc > 0.97, f"accuracy {acc}"
