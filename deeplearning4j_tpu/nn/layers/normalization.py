@@ -1,0 +1,120 @@
+"""Normalization layers: BatchNormalization and LocalResponseNormalization.
+
+Equivalents of the reference ``nn/conf/layers/BatchNormalization.java`` /
+``nn/layers/normalization/BatchNormalization.java`` (452 LoC) and
+``LocalResponseNormalization.java``, with the cuDNN helper tier replaced by
+fused XLA elementwise ops (``ops.convolution.batch_norm_*``).
+
+State-layout note (serialization-parity gotcha, SURVEY.md §2.1): the
+reference stores the non-trainable running mean/var *inside the param
+vector* (``BatchNormalizationParamInitializer.java:26,66-76`` — order gamma,
+beta, mean, var).  Here they live in the layer ``state`` pytree (pure-function
+friendly); the ModelSerializer stores them in a separate ``state.bin`` entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import convolution as conv_ops
+from ..conf import inputs as _inputs
+from ..conf import serde
+from .base import Array, BaseLayerConfig, ParamTree, StateTree
+
+InputType = _inputs.InputType
+
+
+@serde.register("batch_norm")
+@dataclasses.dataclass
+class BatchNormalization(BaseLayerConfig):
+    """Batch normalization over the feature/channel axis.
+
+    Defaults mirror the reference config: decay 0.9 (running-average
+    momentum), eps 1e-5, optional gamma/beta locking (``lockGammaBeta`` —
+    fixed values, no learning).
+    """
+
+    INPUT_KIND = "any"
+
+    n_out: int = 0            # feature/channel count (inferred)
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    activation: str = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_out <= 0:
+            if input_type.kind in ("cnn", "cnn_flat"):
+                self.n_out = input_type.channels
+            else:
+                self.n_out = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def param_order(self) -> tuple[str, ...]:
+        return () if self.lock_gamma_beta else ("gamma", "beta")
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        if self.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
+            "beta": jnp.full((self.n_out,), self.beta_init, dtype),
+        }
+
+    def init_state(self) -> StateTree:
+        return {
+            "mean": jnp.zeros((self.n_out,), jnp.float32),
+            "var": jnp.ones((self.n_out,), jnp.float32),
+        }
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None):
+        gamma = params.get("gamma",
+                           jnp.asarray(self.gamma_init, x.dtype))
+        beta = params.get("beta", jnp.asarray(self.beta_init, x.dtype))
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            out, mean, var = conv_ops.batch_norm_train(
+                x, gamma, beta, axes, self.eps)
+            d = self.decay
+            new_state = {
+                "mean": d * state["mean"] + (1.0 - d) * mean,
+                "var": d * state["var"] + (1.0 - d) * var,
+            }
+            return self._activate(out), new_state
+        out = conv_ops.batch_norm_inference(
+            x, gamma, beta, state["mean"], state["var"], self.eps)
+        return self._activate(out), state
+
+
+@serde.register("lrn")
+@dataclasses.dataclass
+class LocalResponseNormalization(BaseLayerConfig):
+    """Cross-channel LRN (reference
+    ``nn/conf/layers/LocalResponseNormalization.java``; defaults k=2, n=5,
+    alpha=1e-4, beta=0.75 as in the reference config)."""
+
+    INPUT_KIND = "cnn"
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+    activation: str = "identity"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None):
+        out = conv_ops.local_response_normalization(
+            x, self.k, self.n, self.alpha, self.beta)
+        return out, state

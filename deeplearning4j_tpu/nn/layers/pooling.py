@@ -1,0 +1,83 @@
+"""Global pooling layer.
+
+Equivalent of the reference ``nn/layers/pooling/GlobalPoolingLayer.java``
+(321 LoC; PoolingType MAX/AVG/SUM/PNORM — ``nn/conf/layers/PoolingType.java``).
+Pools CNN activations over H,W or RNN activations over time, with optional
+per-timestep mask support (reference ``MaskedReductionUtil``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf import inputs as _inputs
+from ..conf import serde
+from .base import Array, BaseLayerConfig, ParamTree, StateTree
+
+InputType = _inputs.InputType
+
+
+@serde.register("global_pooling")
+@dataclasses.dataclass
+class GlobalPoolingLayer(BaseLayerConfig):
+    """pooling_type: max | avg | sum | pnorm; collapses spatial/time axes."""
+
+    INPUT_KIND = "any"
+
+    pooling_type: str = "avg"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+    activation: str = "identity"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind in ("cnn", "cnn_flat"):
+            return _inputs.feed_forward(input_type.channels)
+        if input_type.kind == "recurrent":
+            return _inputs.feed_forward(input_type.size)
+        return input_type
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask: Optional[Array] = None):
+        if x.ndim == 4:        # NHWC -> pool over H,W
+            axes = (1, 2)
+            m = None
+        elif x.ndim == 3:      # (batch, time, features) -> pool over time
+            axes = (1,)
+            m = mask
+        else:
+            return x, state
+        kind = self.pooling_type
+        if m is not None:
+            mm = m[..., None]  # (batch, time, 1)
+            if kind == "max":
+                neg = jnp.finfo(x.dtype).min
+                out = jnp.max(jnp.where(mm > 0, x, neg), axis=axes)
+            elif kind in ("avg", "sum"):
+                total = jnp.sum(x * mm, axis=axes)
+                if kind == "sum":
+                    out = total
+                else:
+                    out = total / jnp.clip(jnp.sum(mm, axis=axes), 1.0, None)
+            elif kind == "pnorm":
+                powed = jnp.power(jnp.abs(x * mm), self.pnorm)
+                out = jnp.power(jnp.sum(powed, axis=axes), 1.0 / self.pnorm)
+            else:
+                raise ValueError(f"Unknown pooling type '{kind}'")
+        else:
+            if kind == "max":
+                out = jnp.max(x, axis=axes)
+            elif kind == "avg":
+                out = jnp.mean(x, axis=axes)
+            elif kind == "sum":
+                out = jnp.sum(x, axis=axes)
+            elif kind == "pnorm":
+                out = jnp.power(
+                    jnp.sum(jnp.power(jnp.abs(x), self.pnorm), axis=axes),
+                    1.0 / self.pnorm)
+            else:
+                raise ValueError(f"Unknown pooling type '{kind}'")
+        return self._activate(out), state

@@ -85,8 +85,11 @@ class MultiLayerNetwork:
         new_state = list(net_state)
         keys = (jax.random.split(rng, n) if rng is not None else [None] * n)
         compute_dtype = self.conf.conf.compute_dtype
-        if compute_dtype:
-            x = x.astype(jnp.dtype(compute_dtype))
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            # Cast inputs to the model dtype (params dtype, or the bfloat16
+            # compute dtype for MXU-friendly matmuls); integer inputs
+            # (embedding indices) pass through.
+            x = x.astype(jnp.dtype(compute_dtype or self.conf.conf.dtype))
         for i in range(n):
             layer = self.layers[i]
             if i in self.conf.input_preprocessors:

@@ -1,0 +1,228 @@
+"""CNN layer family tests: shapes, gradient checks, LeNet training
+(analogues of reference CNNGradientCheckTest.java, BNGradientCheckTest.java,
+LRNGradientCheckTests.java, ConvolutionLayerTest.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                      SubsamplingLayer,
+                                                      ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization, LocalResponseNormalization)
+from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+from deeplearning4j_tpu.ops import convolution as conv_ops
+
+
+def _img_ds(n=4, h=8, w=8, c=1, n_classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, h * w * c)
+    Y = np.eye(n_classes)[rng.randint(0, n_classes, n)]
+    return DataSet(X, Y)
+
+
+def _cnn_net(layers, h=8, w=8, c=1, dtype="float64"):
+    b = (NeuralNetConfiguration.builder().seed(12345).dtype(dtype)
+         .updater("sgd").learning_rate(0.1).weight_init("xavier"))
+    lb = b.list()
+    for l in layers:
+        lb.layer(l)
+    lb.set_input_type(inputs.convolutional_flat(h, w, c))
+    return MultiLayerNetwork(lb.build()).init()
+
+
+# ------------------------------ ops tests ----------------------------------
+
+def test_conv_output_size_modes():
+    assert conv_ops.conv_output_size(28, 5, 1, 0, "truncate") == 24
+    assert conv_ops.conv_output_size(28, 5, 1, 2, "truncate") == 28
+    assert conv_ops.conv_output_size(28, 5, 2, 0, "same") == 14
+    with pytest.raises(ValueError):
+        conv_ops.conv_output_size(28, 5, 3, 0, "strict")
+
+
+def test_conv2d_known_values():
+    import jax.numpy as jnp
+    x = jnp.ones((1, 3, 3, 1))
+    k = jnp.ones((2, 2, 1, 1))
+    out = conv_ops.conv2d(x, k)
+    assert out.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(np.asarray(out), np.full((1, 2, 2, 1), 4.0))
+
+
+def test_pool2d_kinds():
+    import jax.numpy as jnp
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    mx = conv_ops.pool2d(x, "max", (2, 2), (2, 2))
+    av = conv_ops.pool2d(x, "avg", (2, 2), (2, 2))
+    sm = conv_ops.pool2d(x, "sum", (2, 2), (2, 2))
+    np.testing.assert_allclose(np.asarray(mx).ravel(), [5, 7, 13, 15])
+    np.testing.assert_allclose(np.asarray(av).ravel(), [2.5, 4.5, 10.5, 12.5])
+    np.testing.assert_allclose(np.asarray(sm).ravel(), [10, 18, 42, 50])
+
+
+def test_lrn_identity_when_alpha_zero():
+    import jax.numpy as jnp
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 3, 4),
+                    jnp.float32)
+    out = conv_ops.local_response_normalization(x, 1.0, 5, 0.0, 0.75)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+# --------------------------- shape inference -------------------------------
+
+def test_cnn_shape_inference_and_preprocessors():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="tanh"),
+        SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+        OutputLayer(n_out=3),
+    ])
+    conf = net.conf
+    assert conf.layers[0].n_in == 1
+    # conv(8->6) pool(6->3) flatten 3*3*4=36
+    assert conf.layers[2].n_in == 36
+    out = net.output(np.random.rand(2, 64).astype(np.float32))
+    assert out.shape == (2, 3)
+
+
+def test_same_mode_preserves_size():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                         convolution_mode="same", activation="relu"),
+        OutputLayer(n_out=3),
+    ])
+    assert net.conf.layers[1].n_in == 8 * 8 * 2
+
+
+def test_zero_padding_layer():
+    net = _cnn_net([
+        ZeroPaddingLayer(padding=(1, 1, 2, 2)),
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="relu"),
+        OutputLayer(n_out=3),
+    ])
+    # 8+2=10 high, 8+4=12 wide -> conv3x3 -> 8x10
+    assert net.conf.layers[2].n_in == 8 * 10 * 2
+
+
+def test_global_pooling_collapses_spatial():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=6, kernel_size=(3, 3), activation="relu"),
+        GlobalPoolingLayer(pooling_type="avg"),
+        OutputLayer(n_out=3),
+    ])
+    assert net.conf.layers[2].n_in == 6
+    out = net.output(np.random.rand(2, 64).astype(np.float32))
+    assert out.shape == (2, 3)
+
+
+# --------------------------- gradient checks -------------------------------
+
+def test_gradcheck_conv_dense():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), activation="tanh"),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=80)
+
+
+@pytest.mark.parametrize("pooling", ["max", "avg", "sum", "pnorm"])
+def test_gradcheck_subsampling(pooling):
+    net = _cnn_net([
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="tanh"),
+        SubsamplingLayer(pooling_type=pooling, kernel_size=(2, 2),
+                         stride=(2, 2)),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=60)
+
+
+def test_gradcheck_batchnorm():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="identity"),
+        BatchNormalization(),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=60)
+
+
+def test_gradcheck_batchnorm_dense():
+    net = _cnn_net([
+        DenseLayer(n_out=8, activation="tanh"),
+        BatchNormalization(),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=60)
+
+
+def test_gradcheck_lrn():
+    net = _cnn_net([
+        ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="tanh"),
+        LocalResponseNormalization(),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=60)
+
+
+@pytest.mark.parametrize("pooling", ["max", "avg", "sum", "pnorm"])
+def test_gradcheck_global_pooling(pooling):
+    net = _cnn_net([
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="tanh"),
+        GlobalPoolingLayer(pooling_type=pooling),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=60)
+
+
+# --------------------------- BN semantics ----------------------------------
+
+def test_batchnorm_running_stats_update_and_inference():
+    import jax.numpy as jnp
+    net = _cnn_net([
+        DenseLayer(n_out=4, activation="identity"),
+        BatchNormalization(decay=0.5),
+        OutputLayer(n_out=3),
+    ], dtype="float32")
+    mean0 = np.asarray(net.net_state[1]["mean"]).copy()
+    ds = _img_ds(n=16)
+    net.fit(ds)
+    mean1 = np.asarray(net.net_state[1]["mean"])
+    assert not np.allclose(mean0, mean1)  # running stats moved
+    # inference twice -> deterministic, uses running stats (state unchanged)
+    out1 = net.output(ds.features)
+    mean2 = np.asarray(net.net_state[1]["mean"])
+    np.testing.assert_allclose(mean1, mean2)
+    np.testing.assert_allclose(out1, net.output(ds.features))
+
+
+def test_batchnorm_normalizes_train_batch():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.convolution import batch_norm_train
+    x = jnp.asarray(np.random.RandomState(0).randn(32, 6) * 5 + 3,
+                    jnp.float32)
+    out, mean, var = batch_norm_train(x, jnp.ones(6), jnp.zeros(6), (0,),
+                                      1e-5)
+    np.testing.assert_allclose(np.asarray(out.mean(0)), np.zeros(6),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.std(0)), np.ones(6), atol=1e-2)
+
+
+# --------------------------- LeNet end-to-end ------------------------------
+
+@pytest.mark.slow
+def test_lenet_trains_mnist():
+    """SURVEY.md §7 stage-2/3 exit test: LeNet-5 on MNIST(-alike) >98%."""
+    from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+
+    net = MultiLayerNetwork(lenet(seed=1)).init()
+    train = MnistDataSetIterator(64, 2048, seed=2)
+    test_it = MnistDataSetIterator(256, 512, train=False, seed=2)
+    net.fit(train, epochs=4)
+    accs = [net.evaluate(b).accuracy() for b in test_it]
+    acc = float(np.mean(accs))
+    assert acc > 0.98, f"accuracy {acc}"
