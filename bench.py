@@ -1,0 +1,80 @@
+"""Benchmark harness: LeNet-5 MNIST training throughput (samples/sec/chip).
+
+North-star metric #1 from BASELINE.md.  The reference publishes no numbers
+(BASELINE.json ``"published": {}``); its instrumentation is
+``PerformanceListener.java:99-102`` (samples/sec).  The baseline constant
+below is this repo's own recorded CPU-XLA floor, so ``vs_baseline`` tracks
+improvement across rounds on the same config.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Recorded floor for this config (see BASELINE.md "Generated baselines"):
+# round-1 CPU-XLA floor on this image (the reference publishes no numbers).
+BASELINE_SAMPLES_PER_SEC = 1488.0
+
+BATCH = 256
+WARMUP_STEPS = 3
+TIMED_STEPS = 40
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.mnist import mnist_arrays
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    # bfloat16 compute on TPU keeps matmuls/convs on the MXU fast path.
+    conf = lenet(compute_dtype="bfloat16" if on_tpu else None)
+    net = MultiLayerNetwork(conf).init()
+
+    features, labels = mnist_arrays(train=True, num_examples=BATCH * 8)
+    features = jnp.asarray(features)
+    labels = jnp.asarray(labels)
+    n_batches = features.shape[0] // BATCH
+    batches = [
+        (features[i * BATCH:(i + 1) * BATCH], labels[i * BATCH:(i + 1) * BATCH])
+        for i in range(n_batches)
+    ]
+
+    def step(i: int) -> None:
+        f, l = batches[i % n_batches]
+        (net.params, net.updater_state, net.net_state, score) = net._train_step(
+            net.params, net.updater_state, net.net_state, net.iteration,
+            f, l, None, net._rng_key)
+        net.iteration += 1
+        return score
+
+    for i in range(WARMUP_STEPS):
+        step(i)
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_STEPS):
+        score = step(i)
+    jax.block_until_ready(net.params)
+    elapsed = time.perf_counter() - t0
+
+    samples_per_sec = TIMED_STEPS * BATCH / elapsed
+    print(json.dumps({
+        "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

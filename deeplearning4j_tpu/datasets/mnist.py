@@ -119,6 +119,19 @@ def _load_real(data_dir: str, train: bool,
     return None
 
 
+def mnist_arrays(train: bool = True, num_examples: int = 60000,
+                 seed: int = 6) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw (features, one-hot labels) arrays: real IDX files if present,
+    else the deterministic procedural set (see module docstring)."""
+    data_dir = os.environ.get(
+        "MNIST_DIR", os.path.expanduser("~/.deeplearning4j_tpu/mnist"))
+    real = _load_real(data_dir, train, num_examples)
+    if real is not None:
+        return real
+    offset = 0 if train else 1_000_003
+    return _generate_synthetic(num_examples, seed + offset)
+
+
 class MnistDataSetIterator(ListDataSetIterator):
     """Reference signature:
     ``MnistDataSetIterator(batch, numExamples, binarize, train, shuffle,
@@ -129,15 +142,7 @@ class MnistDataSetIterator(ListDataSetIterator):
     def __init__(self, batch: int, num_examples: int = 60000,
                  binarize: bool = False, train: bool = True,
                  shuffle: bool = True, seed: int = 6):
-        data_dir = os.environ.get(
-            "MNIST_DIR",
-            os.path.expanduser("~/.deeplearning4j_tpu/mnist"))
-        real = _load_real(data_dir, train, num_examples)
-        if real is not None:
-            images, labels = real
-        else:
-            offset = 0 if train else 1_000_003  # disjoint synthetic pools
-            images, labels = _generate_synthetic(num_examples, seed + offset)
+        images, labels = mnist_arrays(train, num_examples, seed)
         if binarize:
             images = (images > 0.3).astype(np.float32)
         super().__init__(DataSet(images, labels), batch, shuffle, seed)

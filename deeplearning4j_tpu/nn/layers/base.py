@@ -79,7 +79,7 @@ class BaseLayerConfig:
     def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
         return {}
 
-    def init_state(self) -> StateTree:
+    def init_state(self, dtype=jnp.float32) -> StateTree:
         return {}
 
     def param_order(self) -> tuple[str, ...]:

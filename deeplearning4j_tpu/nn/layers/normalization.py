@@ -69,10 +69,10 @@ class BatchNormalization(BaseLayerConfig):
             "beta": jnp.full((self.n_out,), self.beta_init, dtype),
         }
 
-    def init_state(self) -> StateTree:
+    def init_state(self, dtype=jnp.float32) -> StateTree:
         return {
-            "mean": jnp.zeros((self.n_out,), jnp.float32),
-            "var": jnp.ones((self.n_out,), jnp.float32),
+            "mean": jnp.zeros((self.n_out,), dtype),
+            "var": jnp.ones((self.n_out,), dtype),
         }
 
     def forward(self, params: ParamTree, state: StateTree, x: Array, *,
@@ -85,9 +85,14 @@ class BatchNormalization(BaseLayerConfig):
             out, mean, var = conv_ops.batch_norm_train(
                 x, gamma, beta, axes, self.eps)
             d = self.decay
+            # Cast to the state dtype: batch stats arrive in the compute
+            # dtype (possibly bf16), and dtype drift in the carried state
+            # would force a retrace+recompile of the donated train step.
             new_state = {
-                "mean": d * state["mean"] + (1.0 - d) * mean,
-                "var": d * state["var"] + (1.0 - d) * var,
+                "mean": (d * state["mean"] + (1.0 - d) * mean).astype(
+                    state["mean"].dtype),
+                "var": (d * state["var"] + (1.0 - d) * var).astype(
+                    state["var"].dtype),
             }
             return self._activate(out), new_state
         out = conv_ops.batch_norm_inference(

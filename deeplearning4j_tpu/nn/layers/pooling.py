@@ -35,8 +35,12 @@ class GlobalPoolingLayer(BaseLayerConfig):
 
     def output_type(self, input_type: InputType) -> InputType:
         if input_type.kind in ("cnn", "cnn_flat"):
+            if not self.collapse_dimensions:
+                return _inputs.convolutional(1, 1, input_type.channels)
             return _inputs.feed_forward(input_type.channels)
         if input_type.kind == "recurrent":
+            if not self.collapse_dimensions:
+                return _inputs.recurrent(input_type.size, 1)
             return _inputs.feed_forward(input_type.size)
         return input_type
 
@@ -51,6 +55,7 @@ class GlobalPoolingLayer(BaseLayerConfig):
         else:
             return x, state
         kind = self.pooling_type
+        keep = not self.collapse_dimensions
         if m is not None:
             mm = m[..., None]  # (batch, time, 1)
             if kind == "max":
@@ -80,4 +85,8 @@ class GlobalPoolingLayer(BaseLayerConfig):
                     1.0 / self.pnorm)
             else:
                 raise ValueError(f"Unknown pooling type '{kind}'")
+        if keep:
+            # collapseDimensions=false keeps unit pooled axes (reference
+            # GlobalPoolingLayer: [n,c,1,1] for CNN, [n,f,1] for RNN).
+            out = jnp.expand_dims(out, axes)
         return self._activate(out), state

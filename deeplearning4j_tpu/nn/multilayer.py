@@ -59,7 +59,7 @@ class MultiLayerNetwork:
             layer.init_params(keys[i], dtype)
             for i, layer in enumerate(self.layers)
         ]
-        self.net_state = [layer.init_state() for layer in self.layers]
+        self.net_state = [layer.init_state(dtype) for layer in self.layers]
         self.updater_state = [
             _updaters.init_state(self._updater_conf(i), self.params[i])
             for i in range(len(self.layers))
@@ -90,6 +90,13 @@ class MultiLayerNetwork:
             # compute dtype for MXU-friendly matmuls); integer inputs
             # (embedding indices) pass through.
             x = x.astype(jnp.dtype(compute_dtype or self.conf.conf.dtype))
+        if compute_dtype:
+            # Mixed precision: master params stay in the param dtype; compute
+            # sees a bfloat16 copy (XLA fuses the casts into the matmul/conv).
+            cast = jnp.dtype(compute_dtype)
+            params = jax.tree.map(
+                lambda p: p.astype(cast)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         for i in range(n):
             layer = self.layers[i]
             if i in self.conf.input_preprocessors:
