@@ -53,7 +53,7 @@ def main() -> None:
         f, l = batches[i % n_batches]
         (net.params, net.updater_state, net.net_state, score) = net._train_step(
             net.params, net.updater_state, net.net_state, net.iteration,
-            f, l, None, net._rng_key)
+            f, l, None, None, net._rng_key)
         net.iteration += 1
         return score
 

@@ -83,7 +83,7 @@ class MultiLayerConfiguration:
     pretrain: bool = False
     backprop_type: str = "standard"      # standard | tbptt
     tbptt_fwd_length: int = 20
-    tbptt_back_length: int = 20
+    tbptt_back_length: int = 0           # 0 = same as tbptt_fwd_length
     input_type: Optional[object] = None
 
     # ---- JSON round-trip (reference MultiLayerConfiguration.java:79-124) --
@@ -364,7 +364,7 @@ def _preprocessor_for(input_type: InputType, want: str):
     """Pick the adapter between an incoming InputType and a layer family
     (reference per-InputType ``getPreProcessorForInputType...``)."""
     kind = input_type.kind
-    if want == "any" or kind == want:
+    if want == "any" or kind == want or (kind, want) == ("recurrent", "rnn"):
         return None
     if kind == "cnn_flat":
         if want == "cnn":

@@ -45,6 +45,8 @@ class MultiLayerNetwork:
         self._init_done = False
         self._score = float("nan")
         self._rng_key: Optional[jax.Array] = None
+        self._rnn_carries = None
+        self._rnn_carry_batch = -1
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -72,17 +74,23 @@ class MultiLayerNetwork:
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, net_state, x, *, train: bool,
-                 rng: Optional[jax.Array], mask=None,
+                 rng: Optional[jax.Array], mask=None, carries=None,
                  to_layer: Optional[int] = None,
                  preoutput_last: bool = False):
         """Compose preprocessors + layers (reference ``feedForwardToLayer``).
 
-        Returns (out, new_state).  With ``preoutput_last`` the final (output)
-        layer contributes its pre-activation, letting the loss fuse
-        softmax/sigmoid stably.
+        Returns (out, new_state, new_carries).  ``mask`` is the per-timestep
+        features mask (batch, time).  ``carries`` is a per-layer list of
+        recurrent carries ((), for non-recurrent layers) used by tBPTT and
+        ``rnn_time_step``; None runs every recurrent layer from zero state.
+        With ``preoutput_last`` the final (output) layer contributes its
+        pre-activation, letting the loss fuse softmax/sigmoid stably.
         """
+        from .layers.recurrent import BaseRecurrentLayer
         n = len(self.layers) if to_layer is None else to_layer + 1
         new_state = list(net_state)
+        new_carries = list(carries) if carries is not None else [
+            () for _ in self.layers]
         keys = (jax.random.split(rng, n) if rng is not None else [None] * n)
         compute_dtype = self.conf.conf.compute_dtype
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -105,31 +113,41 @@ class MultiLayerNetwork:
                 if layer.dropout and train:
                     x = layer.apply_dropout(x, train, keys[i])
                 x = layer.pre_output(params[i], x)
+            elif (carries is not None
+                  and isinstance(layer, BaseRecurrentLayer)):
+                x, new_carries[i] = layer.forward_seq(
+                    params[i], x, carries[i], train=train, rng=keys[i],
+                    mask=mask)
             else:
                 x, new_state[i] = layer.forward(
                     params[i], net_state[i], x, train=train, rng=keys[i],
                     mask=mask)
         if compute_dtype:
             x = x.astype(jnp.float32)
-        return x, new_state
+        return x, new_state, new_carries
 
     # ----------------------------------------------------------------- loss
-    def _loss_fn(self, params, net_state, features, labels, labels_mask,
-                 rng, train: bool):
-        """Data loss (+ new state).  Regularization is handled updater-side
-        to match the reference order of operations (SURVEY.md §7 hard part d);
-        the reported score adds the reg term separately
+    def _loss_fn(self, params, net_state, features, labels, features_mask,
+                 labels_mask, rng, train: bool, carries=None):
+        """Data loss (+ new state, new carries).  Regularization is handled
+        updater-side to match the reference order of operations (SURVEY.md §7
+        hard part d); the reported score adds the reg term separately
         (``BaseLayer.calcL2``)."""
-        preout, new_state = self._forward(
+        preout, new_state, new_carries = self._forward(
             params, net_state, features, train=train, rng=rng,
-            preoutput_last=True)
+            mask=features_mask, carries=carries, preoutput_last=True)
         out_layer = self.layers[-1]
         if not hasattr(out_layer, "compute_score"):
             raise ValueError(
                 "Last layer must be an output/loss layer to fit()")
-        data_loss = out_layer.compute_score(labels, preout, labels_mask,
+        lmask = labels_mask
+        if lmask is None and features_mask is not None and preout.ndim == 3:
+            # Per-timestep output: the features mask doubles as the labels
+            # mask (reference feedForwardMaskArray propagation).
+            lmask = features_mask
+        data_loss = out_layer.compute_score(labels, preout, lmask,
                                             average=self.conf.conf.mini_batch)
-        return data_loss, new_state
+        return data_loss, (new_state, new_carries)
 
     def _reg_score(self, params) -> Array:
         total = jnp.asarray(0.0, jnp.float32)
@@ -139,6 +157,29 @@ class MultiLayerNetwork:
         return total
 
     # ------------------------------------------------------------ train step
+    def _apply_updates(self, params, updater_state, grads, iteration):
+        """DL4J-order updater application (l1/l2 into grad, grad-norm, then
+        per-param update rule)."""
+        new_params, new_updater_state = [], []
+        for i, layer in enumerate(self.layers):
+            uconf = self._updater_conf(i)
+            g = grads[i]
+            if g:
+                g = _updaters.regularize(g, params[i], layer.l1_by_param(),
+                                         layer.l2_by_param())
+                g = _updaters.normalize_gradients(
+                    g, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold)
+                updates, ustate = _updaters.compute_update(
+                    uconf, g, updater_state[i], iteration)
+                new_params.append(jax.tree.map(
+                    lambda p, u: p - u, params[i], updates))
+                new_updater_state.append(ustate)
+            else:
+                new_params.append(params[i])
+                new_updater_state.append(updater_state[i])
+        return new_params, new_updater_state
+
     @functools.cached_property
     def _train_step(self):
         """Build the jitted train step: fwd + bwd + updater in one XLA
@@ -146,49 +187,75 @@ class MultiLayerNetwork:
         HBM (the analogue of the reference's in-place flat-buffer step)."""
 
         def step(params, updater_state, net_state, iteration, features,
-                 labels, labels_mask, base_rng):
+                 labels, features_mask, labels_mask, base_rng):
             rng = jax.random.fold_in(base_rng, iteration)
-            (data_loss, new_state), grads = jax.value_and_grad(
+            (data_loss, (new_state, _)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
-                    params, net_state, features, labels, labels_mask, rng,
-                    True)
-            new_params, new_updater_state = [], []
-            for i, layer in enumerate(self.layers):
-                uconf = self._updater_conf(i)
-                g = grads[i]
-                if g:
-                    g = _updaters.regularize(g, params[i], layer.l1_by_param(),
-                                             layer.l2_by_param())
-                    g = _updaters.normalize_gradients(
-                        g, layer.gradient_normalization,
-                        layer.gradient_normalization_threshold)
-                    updates, ustate = _updaters.compute_update(
-                        uconf, g, updater_state[i], iteration)
-                    new_params.append(jax.tree.map(
-                        lambda p, u: p - u, params[i], updates))
-                    new_updater_state.append(ustate)
-                else:
-                    new_params.append(params[i])
-                    new_updater_state.append(updater_state[i])
+                    params, net_state, features, labels, features_mask,
+                    labels_mask, rng, True)
+            new_params, new_updater_state = self._apply_updates(
+                params, updater_state, grads, iteration)
             score = data_loss + self._reg_score(params)
             return new_params, new_updater_state, new_state, score
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
+    def _tbptt_step(self):
+        """Truncated-BPTT window step (reference ``doTruncatedBPTT:1138``):
+        one fwd+bwd+update over a time window, with recurrent state carried
+        in from the previous window and treated as a constant (gradients do
+        not flow across window boundaries)."""
+
+        def step(params, updater_state, net_state, carries, iteration,
+                 features, labels, features_mask, labels_mask, base_rng):
+            rng = jax.random.fold_in(base_rng, iteration)
+            carries = jax.lax.stop_gradient(carries)
+
+            def loss(p, ns, f, l, fm, lm, r):
+                return self._loss_fn(p, ns, f, l, fm, lm, r, True,
+                                     carries=carries)
+
+            (data_loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss, has_aux=True)(
+                    params, net_state, features, labels, features_mask,
+                    labels_mask, rng)
+            new_params, new_updater_state = self._apply_updates(
+                params, updater_state, grads, iteration)
+            score = data_loss + self._reg_score(params)
+            return (new_params, new_updater_state, new_state, new_carries,
+                    score)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    @functools.cached_property
     def _score_fn(self):
-        def score(params, net_state, features, labels, labels_mask):
+        def score(params, net_state, features, labels, features_mask,
+                  labels_mask):
             data_loss, _ = self._loss_fn(params, net_state, features, labels,
-                                         labels_mask, None, False)
+                                         features_mask, labels_mask, None,
+                                         False)
             return data_loss + self._reg_score(params)
         return jax.jit(score)
 
     @functools.cached_property
     def _output_fn(self):
-        def run(params, net_state, features):
-            out, _ = self._forward(params, net_state, features, train=False,
-                                   rng=None)
+        def run(params, net_state, features, features_mask):
+            out, _, _ = self._forward(params, net_state, features,
+                                      train=False, rng=None,
+                                      mask=features_mask)
             return out
+        return jax.jit(run)
+
+    @functools.cached_property
+    def _rnn_step_fn(self):
+        """Streaming inference step (reference ``rnnTimeStep:2230``): forward
+        with explicit carries in/out, jitted once and reused per step."""
+        def run(params, net_state, carries, features):
+            out, _, new_carries = self._forward(
+                params, net_state, features, train=False, rng=None,
+                carries=carries)
+            return out, new_carries
         return jax.jit(run)
 
     # ------------------------------------------------------------------- fit
@@ -228,37 +295,139 @@ class MultiLayerNetwork:
         self.last_batch_size = ds.num_examples()
         features = jnp.asarray(ds.features)
         labels = jnp.asarray(ds.labels)
+        fmask = (None if ds.features_mask is None
+                 else jnp.asarray(ds.features_mask))
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if self.conf.backprop_type == "tbptt":
+            for _ in range(self.conf.conf.num_iterations):
+                self._fit_tbptt(features, labels, fmask, lmask)
+            return
         for _ in range(self.conf.conf.num_iterations):
             (self.params, self.updater_state, self.net_state,
              score) = self._train_step(
                 self.params, self.updater_state, self.net_state,
-                self.iteration, features, labels, lmask, self._rng_key)
+                self.iteration, features, labels, fmask, lmask,
+                self._rng_key)
             self._score = score
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
 
+    def _fit_tbptt(self, features, labels, fmask, lmask) -> None:
+        """Slice the time axis into tbptt_fwd_length windows, carrying
+        recurrent state forward across windows (reference
+        ``doTruncatedBPTT:1138`` + ``updateRnnStateWithTBPTTState:1187``).
+        State is cleared at the start of each new minibatch."""
+        self._require_carry_support("truncated BPTT")
+        if labels.ndim < 3:
+            raise ValueError(
+                "Truncated BPTT needs per-timestep labels (batch, time, ...); "
+                f"got shape {labels.shape}. Use standard backprop for "
+                "sequence-level labels.")
+        bl = self.conf.tbptt_back_length
+        if bl and bl != self.conf.tbptt_fwd_length:
+            raise ValueError(
+                "tbptt_back_length != tbptt_fwd_length is not supported: "
+                "gradients flow through the full forward window (set both "
+                "lengths equal, the reference's common configuration)")
+        T = features.shape[1]
+        window = self.conf.tbptt_fwd_length
+        carries = self._init_carries(features.shape[0])
+        scores = []
+        for start in range(0, T, window):
+            sl = slice(start, min(start + window, T))
+            f = features[:, sl]
+            l = labels[:, sl]
+            fm = None if fmask is None else fmask[:, sl]
+            lm = None if lmask is None else lmask[:, sl]
+            (self.params, self.updater_state, self.net_state, carries,
+             score) = self._tbptt_step(
+                self.params, self.updater_state, self.net_state, carries,
+                self.iteration, f, l, fm, lm, self._rng_key)
+            scores.append(score)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+        self._score = scores[-1] if scores else self._score
+
+    def _require_carry_support(self, what: str) -> None:
+        """Bidirectional layers cannot carry state across time chunks
+        (reference GravesBidirectionalLSTM.rnnTimeStep throws
+        UnsupportedOperationException)."""
+        from .layers.recurrent import BaseRecurrentLayer
+        for i, layer in enumerate(self.layers):
+            if (isinstance(layer, BaseRecurrentLayer)
+                    and not layer.SUPPORTS_CARRY):
+                raise ValueError(
+                    f"Layer {i} ({type(layer).__name__}) does not support "
+                    f"{what}: its backward pass needs the full sequence")
+
+    def _init_carries(self, batch: int):
+        """Zero recurrent carries, one entry per layer (() if stateless)."""
+        from .layers.recurrent import BaseRecurrentLayer
+        dtype = jnp.dtype(self.conf.conf.compute_dtype
+                          or self.conf.conf.dtype)
+        return [layer.init_carry(batch, dtype)
+                if isinstance(layer, BaseRecurrentLayer) else ()
+                for layer in self.layers]
+
     # ------------------------------------------------------------- inference
-    def output(self, features, train: bool = False) -> np.ndarray:
+    def output(self, features, train: bool = False,
+               features_mask=None) -> np.ndarray:
         """Forward pass (reference ``output:1519-1601``; TEST mode: no
         dropout, BN running stats)."""
         self.init()
+        fmask = None if features_mask is None else jnp.asarray(features_mask)
         out = self._output_fn(self.params, self.net_state,
-                              jnp.asarray(features))
+                              jnp.asarray(features), fmask)
         return np.asarray(out)
 
     def feed_forward(self, features) -> List[np.ndarray]:
         """All layer activations (reference ``feedForward:655-747``)."""
         self.init()
         acts = []
-        x = jnp.asarray(features)
         for i in range(len(self.layers)):
-            x, _ = self._forward(self.params, self.net_state,
-                                 jnp.asarray(features), train=False, rng=None,
-                                 to_layer=i)
+            x, _, _ = self._forward(self.params, self.net_state,
+                                    jnp.asarray(features), train=False,
+                                    rng=None, to_layer=i)
             acts.append(np.asarray(x))
         return acts
+
+    # --------------------------------------------- rnn streaming state API
+    def rnn_time_step(self, features) -> np.ndarray:
+        """Stateful streaming inference (reference ``rnnTimeStep:2230``):
+        feeds one or more timesteps, carrying hidden state between calls.
+        2-D input (batch, features) is one timestep and returns
+        (batch, n_out); 3-D input returns the full (batch, time, n_out)."""
+        self.init()
+        self._require_carry_support("rnn_time_step")
+        x = jnp.asarray(features)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if (self._rnn_carries is None
+                or self._rnn_carry_batch != x.shape[0]):
+            self._rnn_carries = self._init_carries(x.shape[0])
+            self._rnn_carry_batch = x.shape[0]
+        out, self._rnn_carries = self._rnn_step_fn(
+            self.params, self.net_state, self._rnn_carries, x)
+        out = np.asarray(out)
+        return out[:, -1] if squeeze else out
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference ``rnnClearPreviousState()``."""
+        self._rnn_carries = None
+        self._rnn_carry_batch = -1
+
+    def rnn_get_previous_state(self, layer: int):
+        """Carry pytree for one layer (reference ``rnnGetPreviousState``)."""
+        return (None if self._rnn_carries is None
+                else self._rnn_carries[layer])
+
+    def rnn_set_previous_state(self, layer: int, state) -> None:
+        if self._rnn_carries is None:
+            raise ValueError("No rnn state yet; call rnn_time_step first")
+        self._rnn_carries[layer] = state
 
     def predict(self, features) -> np.ndarray:
         """Argmax class predictions (reference ``predict``)."""
@@ -269,16 +438,19 @@ class MultiLayerNetwork:
         if dataset is None:
             return float(self._score)
         self.init()
+        fmask = (None if dataset.features_mask is None
+                 else jnp.asarray(dataset.features_mask))
         lmask = (None if dataset.labels_mask is None
                  else jnp.asarray(dataset.labels_mask))
         val = self._score_fn(self.params, self.net_state,
                              jnp.asarray(dataset.features),
-                             jnp.asarray(dataset.labels), lmask)
+                             jnp.asarray(dataset.labels), fmask, lmask)
         return float(val)
 
     def evaluate(self, iterator):
         """Classification evaluation over an iterator (reference
-        ``MultiLayerNetwork.evaluate``)."""
+        ``MultiLayerNetwork.evaluate``; time-series outputs go through the
+        masked ``evalTimeSeries`` path)."""
         from ..eval.evaluation import Evaluation
         ev = Evaluation()
         if isinstance(iterator, DataSet):
@@ -286,8 +458,15 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            out = self.output(ds.features)
-            ev.eval(np.asarray(ds.labels), out)
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            labels = np.asarray(ds.labels)
+            if out.ndim == 3:
+                mask = (ds.labels_mask if ds.labels_mask is not None
+                        else ds.features_mask)
+                ev.eval_time_series(labels, out,
+                                    None if mask is None else np.asarray(mask))
+            else:
+                ev.eval(labels, out)
         return ev
 
     # ------------------------------------------------ flat-param invariant

@@ -1,0 +1,188 @@
+"""Recurrent layer tests, modeled on the reference's
+``gradientcheck/GradientCheckTests.java`` (LSTM cases),
+``nn/layers/recurrent/GravesLSTMTest.java`` /
+``GravesBidirectionalLSTMTest.java``, and the MLN tBPTT/rnnTimeStep tests in
+``nn/multilayer/MultiLayerTest.java`` (``rnnTimeStep:2230``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    MultiLayerConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    GravesBidirectionalLSTM, GravesLSTM, RnnOutputLayer)
+
+
+def _seq_ds(n=4, t=6, n_in=3, n_classes=3, seed=0, mask=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, t, n_in)
+    Y = np.zeros((n, t, n_classes))
+    idx = rng.randint(0, n_classes, (n, t))
+    for i in range(n):
+        Y[i, np.arange(t), idx[i]] = 1.0
+    fm = None
+    if mask:
+        lengths = rng.randint(2, t + 1, n)
+        fm = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float64)
+    return DataSet(X, Y, features_mask=fm, labels_mask=fm)
+
+
+def _net(layers, tbptt=None, seed=12345):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .dtype("float64").updater("sgd").learning_rate(0.1)
+         .activation("tanh").weight_init("xavier"))
+    lb = b.list()
+    for l in layers:
+        lb.layer(l)
+    lb.set_input_type(inputs.recurrent(3, 6))
+    if tbptt:
+        lb.backprop_type("tbptt")
+        lb.t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+    return MultiLayerNetwork(lb.build()).init()
+
+
+# ---------------------------------------------------------------- gradients
+def test_lstm_gradients():
+    net = _net([GravesLSTM(n_out=4),
+                RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")])
+    assert check_gradients(net, _seq_ds())
+
+
+def test_lstm_gradients_masked():
+    net = _net([GravesLSTM(n_out=4),
+                RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")])
+    assert check_gradients(net, _seq_ds(mask=True))
+
+
+def test_bidirectional_lstm_gradients():
+    net = _net([GravesBidirectionalLSTM(n_out=4),
+                RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")])
+    assert check_gradients(net, _seq_ds())
+
+
+def test_stacked_lstm_gradients():
+    net = _net([GravesLSTM(n_out=4), GravesLSTM(n_out=3),
+                RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")])
+    assert check_gradients(net, _seq_ds())
+
+
+def test_lstm_mse_regression_gradients():
+    ds = _seq_ds()
+    ds = DataSet(ds.features, np.random.RandomState(1).randn(4, 6, 3))
+    net = _net([GravesLSTM(n_out=4),
+                RnnOutputLayer(n_out=3, activation="identity", loss="mse")])
+    assert check_gradients(net, ds)
+
+
+# ----------------------------------------------------------------- forward
+def test_lstm_output_shape_and_mask_zeroing():
+    net = _net([GravesLSTM(n_out=5),
+                RnnOutputLayer(n_out=3)])
+    ds = _seq_ds(mask=True)
+    out = net.output(ds.features, features_mask=ds.features_mask)
+    assert out.shape == (4, 6, 3)
+    acts = net._forward(net.params, net.net_state,
+                        np.asarray(ds.features, np.float64), train=False,
+                        rng=None, mask=np.asarray(ds.features_mask),
+                        to_layer=0)[0]
+    # Masked timesteps must emit exactly zero from the LSTM.
+    m = np.asarray(ds.features_mask)
+    assert np.all(np.asarray(acts)[m == 0] == 0.0)
+
+
+def test_bidirectional_differs_from_unidirectional():
+    uni = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    bi = _net([GravesBidirectionalLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    ds = _seq_ds()
+    assert not np.allclose(uni.output(ds.features), bi.output(ds.features))
+
+
+# ------------------------------------------------------------- rnnTimeStep
+def test_rnn_time_step_matches_full_sequence():
+    net = _net([GravesLSTM(n_out=4), GravesLSTM(n_out=4),
+                RnnOutputLayer(n_out=3)])
+    ds = _seq_ds()
+    full = net.output(ds.features)
+    net.rnn_clear_previous_state()
+    stepped = []
+    for t in range(ds.features.shape[1]):
+        stepped.append(net.rnn_time_step(ds.features[:, t]))
+    stepped = np.stack(stepped, axis=1)
+    np.testing.assert_allclose(full, stepped, rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_time_step_chunked_matches():
+    net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    ds = _seq_ds()
+    full = net.output(ds.features)
+    net.rnn_clear_previous_state()
+    a = net.rnn_time_step(ds.features[:, :2])
+    b = net.rnn_time_step(ds.features[:, 2:])
+    np.testing.assert_allclose(full, np.concatenate([a, b], axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_clear_state_resets():
+    net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    ds = _seq_ds()
+    first = net.rnn_time_step(ds.features[:, 0])
+    second_carried = net.rnn_time_step(ds.features[:, 0])
+    assert not np.allclose(first, second_carried)
+    net.rnn_clear_previous_state()
+    np.testing.assert_allclose(first, net.rnn_time_step(ds.features[:, 0]))
+
+
+# ------------------------------------------------------------------ tBPTT
+def test_tbptt_training_decreases_score():
+    # Learnable toy task: predict the sign pattern of the cumulative sum.
+    rng = np.random.RandomState(7)
+    X = rng.randn(16, 12, 3)
+    cls = (np.cumsum(X.sum(-1), axis=1) > 0).astype(int)
+    Y = np.eye(3)[cls + 1]
+    ds = DataSet(X, Y)
+    net = _net([GravesLSTM(n_out=8), RnnOutputLayer(n_out=3)], tbptt=4)
+    net.fit(ds)
+    s0 = net.score(ds)
+    net.fit(ds, epochs=30)
+    assert net.score(ds) < s0 * 0.7
+    # 12 timesteps / window 4 = 3 iterations per fit call
+    assert net.iteration == 31 * 3
+
+
+def test_tbptt_equals_standard_when_window_covers_sequence():
+    # One window spanning the whole sequence ==> same gradients as standard
+    # backprop, so one fit step must produce identical params.
+    ds = _seq_ds()
+    a = _net([GravesLSTM(n_out=4, ), RnnOutputLayer(n_out=3)], tbptt=6)
+    b = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    a.fit(ds)
+    b.fit(ds)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-10)
+
+
+# ------------------------------------------------------------------- serde
+def test_lstm_config_json_roundtrip():
+    conf = _net([GravesBidirectionalLSTM(n_out=4),
+                 RnnOutputLayer(n_out=3)]).conf
+    restored = MultiLayerConfiguration.from_json(conf.to_json())
+    assert isinstance(restored.layers[0], GravesBidirectionalLSTM)
+    assert restored.layers[0].n_in == 3
+    assert restored.backprop_type == conf.backprop_type
+
+
+def test_lstm_model_serializer_roundtrip(tmp_path):
+    from deeplearning4j_tpu.utils.model_serializer import (restore_multi_layer_network,
+                                                           write_model)
+    net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)])
+    ds = _seq_ds()
+    net.fit(ds)
+    path = str(tmp_path / "lstm.zip")
+    write_model(net, path, save_updater=True)
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_allclose(net.output(ds.features),
+                               restored.output(ds.features), rtol=1e-6)
