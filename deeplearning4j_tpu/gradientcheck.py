@@ -101,3 +101,79 @@ def check_gradients(net, dataset, eps: float = DEFAULT_EPS,
         print(f"GradientCheck: {n_pass} passed, {n_fail} failed "
               f"(maxRelError={max_err:.4g})")
     return n_fail == 0
+
+
+def check_gradients_graph(net, mds, eps: float = DEFAULT_EPS,
+                          max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                          min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+                          print_results: bool = False,
+                          subset: Optional[int] = None,
+                          seed: int = 0) -> bool:
+    """ComputationGraph variant (reference
+    ``GradientCheckUtil.checkGradients(ComputationGraph):222``)."""
+    from .datasets.dataset import DataSet, MultiDataSet
+    net.init()
+    if isinstance(mds, DataSet):
+        from .nn.computation_graph import _as_multi
+        mds = _as_multi(mds)
+    features = tuple(jnp.asarray(f) for f in mds.features)
+    labels = tuple(jnp.asarray(l) for l in mds.labels)
+    fmasks = (None if mds.features_masks is None else tuple(
+        None if m is None else jnp.asarray(m) for m in mds.features_masks))
+    lmasks = (None if mds.labels_masks is None else tuple(
+        None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+
+    def total_loss_fn(params):
+        data_loss, _ = net._loss_fn(params, net.net_state, features, labels,
+                                    fmasks, lmasks, None, False)
+        return data_loss + net._reg_score(params)
+
+    total_loss = jax.jit(total_loss_fn)
+    analytic_tree = jax.grad(total_loss_fn)(net.params)
+
+    analytic = []
+    for name in net._layer_names():
+        for p in net.vertices[name].layer.param_order():
+            analytic.append(np.asarray(analytic_tree[name][p]).ravel())
+    analytic = (np.concatenate(analytic) if analytic
+                else np.zeros((0,), np.float64))
+
+    flat0 = net.get_flat_params().astype(np.float64)
+    n = flat0.size
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.random.RandomState(seed).choice(n, subset, replace=False)
+
+    def loss_at(flat) -> float:
+        net.set_flat_params(flat)
+        return float(total_loss(net.params))
+
+    n_pass = n_fail = 0
+    max_err = 0.0
+    try:
+        for j in idxs:
+            orig = flat0[j]
+            flat0[j] = orig + eps
+            f_plus = loss_at(flat0)
+            flat0[j] = orig - eps
+            f_minus = loss_at(flat0)
+            flat0[j] = orig
+            numeric = (f_plus - f_minus) / (2.0 * eps)
+            a = float(analytic[j])
+            denom = abs(a) + abs(numeric)
+            rel = 0.0 if denom == 0 else abs(a - numeric) / denom
+            if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+                n_fail += 1
+                if print_results:
+                    print(f"param {j}: analytic={a:.8g} "
+                          f"numeric={numeric:.8g} rel={rel:.4g} FAIL")
+            else:
+                n_pass += 1
+            max_err = max(max_err, rel)
+    finally:
+        net.set_flat_params(flat0)
+
+    if print_results:
+        print(f"GradientCheck(graph): {n_pass} passed, {n_fail} failed "
+              f"(maxRelError={max_err:.4g})")
+    return n_fail == 0

@@ -89,6 +89,13 @@ def from_dict(d: Any, cls: Type[T] | None = None) -> Any:
             ]
         elif isinstance(value, dict) and dataclasses.is_dataclass(_field_type(f)):
             kwargs[key] = from_dict(value, _field_type(f))
+        elif isinstance(value, dict):
+            # Plain mapping whose values may be polymorphic configs
+            # (e.g. ComputationGraphConfiguration.vertices).
+            kwargs[key] = {
+                k: (from_dict(v) if isinstance(v, dict) and "type" in v
+                    else v)
+                for k, v in value.items()}
         else:
             kwargs[key] = value
     # tuples serialized as lists: coerce back where the default is a tuple

@@ -102,7 +102,9 @@ def _flatten_state(net):
 
     chunks, manifest = [], []
     offset = 0
-    for i, tree in enumerate(net.net_state):
+    items = (net.net_state.items() if isinstance(net.net_state, dict)
+             else enumerate(net.net_state))
+    for i, tree in items:
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             arr = np.asarray(leaf)
             manifest.append({

@@ -1,0 +1,302 @@
+"""ComputationGraph tests, modeled on the reference's
+``gradientcheck/GradientCheckTestsComputationGraph.java`` and
+``nn/graph/graphnodes`` vertex tests (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients_graph
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.computation_graph import (
+    ComputationGraphConfiguration, ElementWiseVertex, L2NormalizeVertex,
+    L2Vertex, LastTimeStepVertex, MergeVertex, ScaleVertex, ShiftVertex,
+    StackVertex, SubsetVertex, UnstackVertex, DuplicateToTimeSeriesVertex)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+
+
+def _builder(seed=12345):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .dtype("float64").updater("sgd").learning_rate(0.1)
+            .activation("tanh").weight_init("xavier").graph_builder())
+
+
+def _ds(n=6, n_in=4, n_classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_in)
+    Y = np.eye(n_classes)[rng.randint(0, n_classes, n)]
+    return DataSet(X, Y)
+
+
+# -------------------------------------------------------------- basic DAGs
+def test_linear_graph_matches_multilayer():
+    """A chain CG must compute exactly what the MLN computes with the same
+    params (reference: CG with single path == MLN)."""
+    g = (_builder().add_inputs("in")
+         .add_layer("dense", DenseLayer(n_in=4, n_out=6), "in")
+         .add_layer("out", OutputLayer(n_in=6, n_out=3), "dense")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+
+    mln_conf = (NeuralNetConfiguration.builder().seed(12345)
+                .dtype("float64").updater("sgd").learning_rate(0.1)
+                .activation("tanh").weight_init("xavier").list()
+                .layer(DenseLayer(n_in=4, n_out=6))
+                .layer(OutputLayer(n_in=6, n_out=3)).build())
+    mln = MultiLayerNetwork(mln_conf).init()
+    cg.set_flat_params(mln.get_flat_params())
+
+    ds = _ds()
+    np.testing.assert_allclose(mln.output(ds.features), cg.output(ds.features),
+                               rtol=1e-10)
+    # and one training step stays identical
+    mln.fit(ds)
+    cg.fit(ds)
+    np.testing.assert_allclose(mln.get_flat_params(), cg.get_flat_params(),
+                               rtol=1e-10)
+
+
+def test_topological_order_and_cycle_detection():
+    g = (_builder().add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=4), "in")
+         .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+         .add_layer("out", OutputLayer(n_in=4, n_out=3), "b")
+         .set_outputs("out").build())
+    order = g.topological_order()
+    assert order.index("a") < order.index("b") < order.index("out")
+
+    bad = (_builder().add_inputs("in"))
+    bad.add_layer("a", DenseLayer(n_in=4, n_out=4), "in", "b")
+    bad.add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+    bad.add_layer("out", OutputLayer(n_in=4, n_out=3), "b")
+    bad.set_outputs("out")
+    with pytest.raises(ValueError, match="cycle"):
+        bad.build()
+
+    unknown = (_builder().add_inputs("in"))
+    unknown.add_layer("a", DenseLayer(n_in=4, n_out=4), "nonexistent")
+    unknown.add_layer("out", OutputLayer(n_in=4, n_out=3), "a")
+    unknown.set_outputs("out")
+    with pytest.raises(ValueError, match="unknown input"):
+        unknown.build()
+
+
+# ---------------------------------------------------------- vertex gradchecks
+def test_merge_vertex_gradients():
+    g = (_builder().add_inputs("in1", "in2")
+         .add_layer("d1", DenseLayer(n_in=3, n_out=4), "in1")
+         .add_layer("d2", DenseLayer(n_in=2, n_out=5), "in2")
+         .add_vertex("merge", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_in=9, n_out=3), "merge")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    mds = MultiDataSet(features=[rng.randn(5, 3), rng.randn(5, 2)],
+                       labels=[np.eye(3)[rng.randint(0, 3, 5)]])
+    assert check_gradients_graph(cg, mds)
+
+
+def test_elementwise_and_skip_connection_gradients():
+    g = (_builder().add_inputs("in")
+         .add_layer("d1", DenseLayer(n_in=4, n_out=4), "in")
+         .add_layer("d2", DenseLayer(n_in=4, n_out=4), "d1")
+         .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+         .add_layer("out", OutputLayer(n_in=4, n_out=3), "add")
+         .set_outputs("out").build())
+    assert check_gradients_graph(ComputationGraph(g).init(), _ds())
+
+
+@pytest.mark.parametrize("op", ["subtract", "product", "average", "max"])
+def test_elementwise_ops_gradients(op):
+    g = (_builder().add_inputs("in")
+         .add_layer("d1", DenseLayer(n_in=4, n_out=4, activation="sigmoid"),
+                    "in")
+         .add_layer("d2", DenseLayer(n_in=4, n_out=4, activation="sigmoid"),
+                    "in")
+         .add_vertex("combine", ElementWiseVertex(op=op), "d1", "d2")
+         .add_layer("out", OutputLayer(n_in=4, n_out=3), "combine")
+         .set_outputs("out").build())
+    assert check_gradients_graph(ComputationGraph(g).init(), _ds())
+
+
+def test_subset_scale_shift_gradients():
+    g = (_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+         .add_vertex("subset", SubsetVertex(from_index=2, to_index=5), "d")
+         .add_vertex("scale", ScaleVertex(scale_factor=1.5), "subset")
+         .add_vertex("shift", ShiftVertex(shift_factor=0.3), "scale")
+         .add_layer("out", OutputLayer(n_in=4, n_out=3), "shift")
+         .set_outputs("out").build())
+    assert check_gradients_graph(ComputationGraph(g).init(), _ds())
+
+
+def test_stack_unstack_gradients():
+    g = (_builder().add_inputs("in1", "in2")
+         .add_vertex("stack", StackVertex(), "in1", "in2")
+         .add_layer("shared", DenseLayer(n_in=3, n_out=4), "stack")
+         .add_vertex("u1", UnstackVertex(from_index=0, stack_size=2),
+                     "shared")
+         .add_vertex("u2", UnstackVertex(from_index=1, stack_size=2),
+                     "shared")
+         .add_vertex("merge", MergeVertex(), "u1", "u2")
+         .add_layer("out", OutputLayer(n_in=8, n_out=3), "merge")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    mds = MultiDataSet(features=[rng.randn(5, 3), rng.randn(5, 3)],
+                       labels=[np.eye(3)[rng.randint(0, 3, 5)]])
+    assert check_gradients_graph(cg, mds)
+
+
+def test_l2_vertices_gradients():
+    g = (_builder().add_inputs("in1", "in2")
+         .add_layer("d1", DenseLayer(n_in=3, n_out=4), "in1")
+         .add_layer("d2", DenseLayer(n_in=3, n_out=4), "in2")
+         .add_vertex("norm", L2NormalizeVertex(), "d1")
+         .add_vertex("dist", L2Vertex(), "norm", "d2")
+         .add_layer("out", OutputLayer(n_in=1, n_out=2,
+                                       activation="sigmoid",
+                                       loss="xent"), "dist")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    rng = np.random.RandomState(3)
+    mds = MultiDataSet(features=[rng.randn(5, 3), rng.randn(5, 3)],
+                       labels=[rng.randint(0, 2, (5, 2)).astype(float)])
+    assert check_gradients_graph(cg, mds)
+
+
+def test_multi_output_gradients():
+    g = (_builder().add_inputs("in")
+         .add_layer("trunk", DenseLayer(n_in=4, n_out=6), "in")
+         .add_layer("out1", OutputLayer(n_in=6, n_out=3), "trunk")
+         .add_layer("out2", OutputLayer(n_in=6, n_out=2,
+                                        activation="identity", loss="mse"),
+                    "trunk")
+         .set_outputs("out1", "out2").build())
+    cg = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    mds = MultiDataSet(features=[rng.randn(5, 4)],
+                       labels=[np.eye(3)[rng.randint(0, 3, 5)],
+                               rng.randn(5, 2)])
+    assert check_gradients_graph(cg, mds)
+
+
+# ------------------------------------------------------------- rnn vertices
+def test_last_time_step_and_duplicate_gradients():
+    g = (_builder().add_inputs("seq", "static")
+         .add_layer("lstm", GravesLSTM(n_in=3, n_out=4), "seq")
+         .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex(
+             reference_input="seq"), "static")
+         .add_layer("rnnout", RnnOutputLayer(n_in=4, n_out=3), "lstm")
+         .add_layer("ffout", OutputLayer(n_in=4, n_out=2), "last")
+         .set_outputs("rnnout", "ffout").build())
+    cg = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    t = 5
+    lengths = rng.randint(2, t + 1, 4)
+    fm = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float64)
+    Y1 = np.zeros((4, t, 3))
+    idx = rng.randint(0, 3, (4, t))
+    for i in range(4):
+        Y1[i, np.arange(t), idx[i]] = 1.0
+    mds = MultiDataSet(
+        features=[rng.randn(4, t, 3), rng.randn(4, 2)],
+        labels=[Y1, np.eye(2)[rng.randint(0, 2, 4)]],
+        features_masks=[fm, None],
+        labels_masks=[fm, None])
+    assert check_gradients_graph(cg, mds)
+
+
+def test_duplicate_to_time_series_forward():
+    g = (_builder().add_inputs("seq", "static")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex(
+             reference_input="seq"), "static")
+         .add_vertex("merge", MergeVertex(), "seq", "dup")
+         .add_layer("out", RnnOutputLayer(n_in=5, n_out=2), "merge")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    out = cg.output(np.random.randn(3, 7, 3), np.random.randn(3, 2))
+    assert out.shape == (3, 7, 2)
+
+
+# ----------------------------------------------------------------- training
+def test_multi_input_training_learns():
+    """XOR-of-two-inputs task through a merge graph."""
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, 2, (200, 1)).astype(float)
+    b_in = rng.randint(0, 2, (200, 1)).astype(float)
+    y = np.eye(2)[(a[:, 0].astype(int) ^ b_in[:, 0].astype(int))]
+    mds = MultiDataSet(features=[a, b_in], labels=[y])
+    g = (NeuralNetConfiguration.builder().seed(7).updater("adam")
+         .learning_rate(0.01).activation("relu").weight_init("xavier")
+         .graph_builder()
+         .add_inputs("a", "b")
+         .add_vertex("merge", MergeVertex(), "a", "b")
+         .add_layer("h", DenseLayer(n_in=2, n_out=16), "merge")
+         .add_layer("out", OutputLayer(n_in=16, n_out=2), "h")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    s0 = None
+    cg.fit(mds, epochs=300)
+    preds = cg.predict(a, b_in)
+    acc = (preds == y.argmax(1)).mean()
+    assert acc > 0.95
+
+
+# ------------------------------------------------------------------- serde
+def test_graph_config_json_roundtrip():
+    g = (_builder().add_inputs("in1", "in2")
+         .add_layer("d1", DenseLayer(n_in=3, n_out=4), "in1")
+         .add_vertex("merge", MergeVertex(), "d1", "in2")
+         .add_layer("out", OutputLayer(n_in=6, n_out=3), "merge")
+         .set_outputs("out").build())
+    restored = ComputationGraphConfiguration.from_json(g.to_json())
+    assert restored.network_inputs == ["in1", "in2"]
+    assert isinstance(restored.vertices["merge"], MergeVertex)
+    assert restored.vertices["merge"].inputs == ["d1", "in2"]
+    assert restored.vertices["out"].layer.n_in == 6
+    assert restored.topological_order() == g.topological_order()
+
+
+def test_graph_model_serializer_roundtrip(tmp_path):
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_computation_graph, write_model)
+    g = (_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+         .add_layer("out", OutputLayer(n_in=5, n_out=3), "d")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    ds = _ds()
+    cg.fit(ds)
+    path = str(tmp_path / "cg.zip")
+    write_model(cg, path)
+    restored = restore_computation_graph(path)
+    np.testing.assert_allclose(cg.output(ds.features),
+                               restored.output(ds.features), rtol=1e-6)
+    restored.fit(ds)  # restored model must keep training (updater state ok)
+
+
+# ----------------------------------------------------------------- shapes
+def test_shape_inference_infers_nin_and_preprocessors():
+    g = (_builder().add_inputs("img")
+         .add_layer("d", DenseLayer(n_out=10), "img")
+         .add_layer("out", OutputLayer(n_out=3), "d")
+         .set_outputs("out")
+         .set_input_types(inputs.convolutional_flat(8, 8, 1)).build())
+    assert g.vertices["d"].layer.n_in == 64
+    assert g.vertices["out"].layer.n_in == 10
+
+
+# -------------------------------------------------------------------- zoo
+def test_resnet50_builds_with_canonical_param_count():
+    from deeplearning4j_tpu.models.resnet import resnet50
+    conf = resnet50(n_classes=1000, height=32, width=32)
+    cg = ComputationGraph(conf).init()
+    assert cg.num_params() == 25_557_032  # canonical ResNet-50
+    out = cg.output(np.random.randn(2, 32, 32, 3).astype(np.float32))
+    assert out.shape == (2, 1000)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-3)
