@@ -1,0 +1,4 @@
+"""Data-parallel training (reference deeplearning4j-scaleout tier)."""
+
+from .parallel_wrapper import ParallelWrapper  # noqa: F401
+from .scaling import measure_throughput, scaling_report  # noqa: F401

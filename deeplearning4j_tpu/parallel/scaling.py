@@ -1,0 +1,73 @@
+"""Scaling-efficiency harness.
+
+BASELINE.md north star: ParallelWrapper scaling efficiency
+``throughput(N) / (N * throughput(1))`` for 1..16 chips (target >=90% at
+v5e-16).  The reference only ships the *mechanism* (workers x avgFreq,
+``ParallelWrapper.java:44-55``); the measurement harness is ours, built on
+the PerformanceListener-style samples/sec accounting.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from .parallel_wrapper import ParallelWrapper
+
+
+def measure_throughput(net_factory: Callable[[], object], workers: int,
+                       batch_size: int = 128, n_rounds: int = 10,
+                       averaging_frequency: int = 1,
+                       feature_shape=(784,), n_classes: int = 10,
+                       warmup_rounds: int = 2,
+                       devices: Optional[list] = None) -> float:
+    """Samples/sec of data-parallel training at ``workers`` devices.
+
+    Each worker consumes ``batch_size`` examples per local step, so one
+    round moves ``workers * averaging_frequency * batch_size`` samples.
+    """
+    rng = np.random.RandomState(0)
+    k = averaging_frequency
+
+    def make_batches(n):
+        return [DataSet(
+            rng.randn(batch_size, *feature_shape).astype(np.float32),
+            np.eye(n_classes, dtype=np.float32)[
+                rng.randint(0, n_classes, batch_size)])
+            for _ in range(n * k * workers)]
+
+    net = net_factory()
+    net.init()
+    pw = ParallelWrapper(net, workers=workers, averaging_frequency=k,
+                         devices=devices)
+    pw.fit(make_batches(warmup_rounds))
+    jax.block_until_ready(net.params)
+
+    batches = make_batches(n_rounds)
+    t0 = time.perf_counter()
+    pw.fit(batches)
+    jax.block_until_ready(net.params)
+    elapsed = time.perf_counter() - t0
+    return len(batches) * batch_size / elapsed
+
+
+def scaling_report(net_factory: Callable[[], object],
+                   worker_counts: List[int], **kw) -> Dict[int, dict]:
+    """Throughput + efficiency per worker count (efficiency relative to the
+    1-worker throughput: throughput(N) / (N * throughput(1)))."""
+    out: Dict[int, dict] = {}
+    base = None
+    for w in worker_counts:
+        tput = measure_throughput(net_factory, w, **kw)
+        if base is None:
+            base = tput / w  # per-chip baseline at the smallest count
+        out[w] = {
+            "workers": w,
+            "samples_per_sec": round(tput, 1),
+            "efficiency": round(tput / (w * base), 4),
+        }
+    return out
