@@ -1,0 +1,12 @@
+"""Early stopping (reference ``deeplearning4j-nn/.../earlystopping/``)."""
+
+from .config import EarlyStoppingConfiguration, EarlyStoppingResult  # noqa: F401
+from .savers import InMemoryModelSaver, LocalFileModelSaver  # noqa: F401
+from .scorecalc import DataSetLossCalculator  # noqa: F401
+from .termination import (BestScoreEpochTerminationCondition,  # noqa: F401
+                          MaxEpochsTerminationCondition,
+                          MaxScoreIterationTerminationCondition,
+                          MaxTimeIterationTerminationCondition,
+                          ScoreImprovementEpochTerminationCondition)
+from .trainer import (EarlyStoppingParallelTrainer,  # noqa: F401
+                      EarlyStoppingTrainer)

@@ -1,0 +1,62 @@
+"""Model savers (reference ``earlystopping/saver/``)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class InMemoryModelSaver:
+    """Reference ``saver/InMemoryModelSaver``: keep best/latest clones."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Reference ``saver/LocalFileModelSaver``: bestModel.bin /
+    latestModel.bin zips in a directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _write(self, net, name: str) -> None:
+        from ..utils.model_serializer import write_model
+        write_model(net, os.path.join(self.directory, name))
+
+    def _read(self, net_cls_hint, name: str):
+        from ..utils.model_serializer import (restore_computation_graph,
+                                              restore_multi_layer_network)
+        path = os.path.join(self.directory, name)
+        if not os.path.exists(path):
+            return None
+        try:
+            return restore_multi_layer_network(path)
+        except Exception:
+            return restore_computation_graph(path)
+
+    def save_best_model(self, net, score: float) -> None:
+        self._write(net, "bestModel.bin")
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._write(net, "latestModel.bin")
+
+    def get_best_model(self):
+        return self._read(None, "bestModel.bin")
+
+    def get_latest_model(self):
+        return self._read(None, "latestModel.bin")

@@ -1,0 +1,359 @@
+"""Keras 1.x HDF5 model import.
+
+TPU-native equivalent of the reference's ``deeplearning4j-modelimport``:
+``KerasModelImport.java:48-156`` (entry points),
+``KerasSequentialModel`` (-> MultiLayerConfiguration),
+``KerasModel.java:59`` (functional API -> ComputationGraph),
+and the per-layer mappers in ``layers/Keras*.java``.
+
+Where the reference walks the file with JavaCPP HDF5 C++ bindings
+(``Hdf5Archive.java``), here h5py provides the (equally native C) HDF5
+access.  Supported Keras 1.x layers: Dense, Activation, Dropout, Flatten,
+Convolution2D, MaxPooling2D, AveragePooling2D, ZeroPadding2D,
+BatchNormalization, LSTM, Embedding + functional-API Merge (concat/sum).
+
+Weight-layout notes (mirroring the reference mappers):
+- Dense: W (in, out), b — identical layout to ours.
+- Convolution2D: Keras 'th' kernels are (nb_filter, stack, kh, kw); 'tf'
+  kernels are (kh, kw, stack, nb_filter) = our HWIO (no transpose needed).
+- LSTM: Keras per-gate arrays [W_i,W_f,W_c,W_o / U_* / b_*] concatenate in
+  DL4J gate order [c|f|o|i] with 3 zeroed peephole columns appended to U
+  (``KerasLstm.java:150-230``).
+- BatchNormalization: gamma, beta, running_mean, running_std (Keras 1 stores
+  std... actually variance; mode=0 feature axis).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf import inputs as _inputs
+from ..nn.conf.computation_graph import MergeVertex, ElementWiseVertex
+from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
+from ..nn.computation_graph import ComputationGraph
+from ..nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
+                              EmbeddingLayer, OutputLayer)
+from ..nn.layers.normalization import BatchNormalization
+from ..nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+from ..nn.multilayer import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
+    "softmax": "softmax", "linear": "identity", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "elu": "elu",
+}
+
+
+def _map_activation(name: str) -> str:
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"Unsupported Keras activation '{name}'")
+    return _ACTIVATIONS[name]
+
+
+def _layer_weights(wgroup, layer_name: str) -> Dict[str, np.ndarray]:
+    """Read {short_param_name: array} for one layer (Keras 1.x layout:
+    group per layer, attrs['weight_names'] ordering)."""
+    if layer_name not in wgroup:
+        return {}
+    g = wgroup[layer_name]
+    names = [n.decode() if isinstance(n, bytes) else str(n)
+             for n in g.attrs.get("weight_names", [])]
+    out = {}
+    for full in names:
+        short = full.split("/")[-1]
+        # keras1 names like 'dense_1_W' -> 'W'; 'lstm_1_W_i' -> 'W_i'
+        for prefix in (layer_name + "_", ):
+            if short.startswith(prefix):
+                short = short[len(prefix):]
+        out[short] = np.asarray(g[full])
+    return out
+
+
+class _ImportedLayer:
+    def __init__(self, conf_layer, params: Optional[Dict[str, np.ndarray]],
+                 state: Optional[Dict[str, np.ndarray]] = None):
+        self.conf_layer = conf_layer
+        self.params = params
+        self.state = state or {}
+
+
+def _convert_layer(cls: str, cfg: dict, weights: Dict[str, np.ndarray],
+                   dim_ordering: Optional[str]) -> Optional[_ImportedLayer]:
+    """One Keras layer config -> our layer config + mapped params.
+    Returns None for no-op layers (Flatten/Input — handled by preprocessors/
+    shape inference)."""
+    act = cfg.get("activation", "linear")
+    if cls == "Dense":
+        layer = DenseLayer(n_out=cfg["output_dim"],
+                           activation=_map_activation(act))
+        return _ImportedLayer(layer, {"W": weights["W"], "b": weights["b"]})
+    if cls == "Activation":
+        return _ImportedLayer(
+            ActivationLayer(activation=_map_activation(act)), None)
+    if cls == "Dropout":
+        return _ImportedLayer(DropoutLayer(dropout=cfg.get("p", 0.0)), None)
+    if cls in ("Flatten", "InputLayer"):
+        return None
+    if cls == "Convolution2D":
+        ordering = cfg.get("dim_ordering", dim_ordering) or "tf"
+        W = weights["W"]
+        if ordering == "th":
+            # (nb_filter, stack, kh, kw) -> HWIO
+            W = W.transpose(2, 3, 1, 0)
+        border = cfg.get("border_mode", "valid")
+        mode = "same" if border == "same" else "truncate"
+        layer = ConvolutionLayer(
+            n_out=cfg["nb_filter"],
+            kernel_size=(cfg["nb_row"], cfg["nb_col"]),
+            stride=tuple(cfg.get("subsample", (1, 1))),
+            convolution_mode=mode,
+            activation=_map_activation(act))
+        return _ImportedLayer(layer, {"W": W, "b": weights["b"]})
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        border = cfg.get("border_mode", "valid")
+        layer = SubsamplingLayer(
+            pooling_type="max" if cls == "MaxPooling2D" else "avg",
+            kernel_size=tuple(cfg.get("pool_size", (2, 2))),
+            stride=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+            convolution_mode="same" if border == "same" else "truncate")
+        return _ImportedLayer(layer, None)
+    if cls == "BatchNormalization":
+        if cfg.get("mode", 0) != 0:
+            raise ValueError("Only BatchNormalization mode=0 supported")
+        layer = BatchNormalization(eps=cfg.get("epsilon", 1e-5))
+        params = {"gamma": weights["gamma"], "beta": weights["beta"]}
+        state = {"mean": weights.get("running_mean"),
+                 "var": weights.get("running_std")}
+        return _ImportedLayer(layer, params, state)
+    if cls == "Embedding":
+        layer = EmbeddingLayer(n_in=cfg["input_dim"],
+                               n_out=cfg["output_dim"],
+                               activation="identity")
+        W = weights["W"]
+        return _ImportedLayer(layer, {"W": W,
+                                      "b": np.zeros(W.shape[1],
+                                                    np.float32)})
+    if cls == "LSTM":
+        H = cfg["output_dim"]
+        inner = _map_activation(cfg.get("inner_activation", "hard_sigmoid"))
+        layer = GravesLSTM(n_out=H, activation=_map_activation(act),
+                           gate_activation_fn=inner,
+                           forget_gate_bias_init=0.0)
+        # DL4J gate order [c|f|o|i] + zero peepholes (KerasLstm.java)
+        W = np.concatenate([weights["W_c"], weights["W_f"], weights["W_o"],
+                            weights["W_i"]], axis=1)
+        U = np.concatenate([weights["U_c"], weights["U_f"], weights["U_o"],
+                            weights["U_i"], np.zeros((H, 3), W.dtype)],
+                           axis=1)
+        b = np.concatenate([weights["b_c"], weights["b_f"], weights["b_o"],
+                            weights["b_i"]])
+        return _ImportedLayer(layer, {"W": W, "RW": U, "b": b})
+    raise ValueError(f"Unsupported Keras layer class '{cls}'")
+
+
+def _keras_input_type(cfg: dict, dim_ordering: str):
+    shape = cfg.get("batch_input_shape")
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return _inputs.feed_forward(dims[0])
+    if len(dims) == 2:
+        return _inputs.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        if dim_ordering == "th":
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return _inputs.convolutional(h, w, c)
+    raise ValueError(f"Cannot map batch_input_shape {shape}")
+
+
+def _open(path: str):
+    import h5py
+    return h5py.File(path, "r")
+
+
+def _model_config(f) -> dict:
+    raw = f.attrs["model_config"]
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8")
+    return json.loads(raw)
+
+
+def _weights_group(f):
+    return f["model_weights"] if "model_weights" in f else f
+
+
+def import_keras_sequential_model_and_weights(path: str,
+                                              train_config: bool = False
+                                              ) -> MultiLayerNetwork:
+    """Reference ``KerasModelImport.importKerasSequentialModelAndWeights``:
+    Keras 1.x Sequential .h5 -> MultiLayerNetwork with copied weights.
+
+    The final Dense+softmax collapses into an OutputLayer (the reference
+    requires a loss layer for training parity; inference is identical).
+    """
+    with _open(path) as f:
+        conf = _model_config(f)
+        if conf["class_name"] != "Sequential":
+            raise ValueError("Not a Sequential model; use "
+                             "import_keras_model_and_weights")
+        layer_confs = conf["config"]
+        wgroup = _weights_group(f)
+
+        builder = (NeuralNetConfiguration.builder().updater("sgd")
+                   .activation("identity").weight_init("xavier").list())
+        imported: List[_ImportedLayer] = []
+        input_type = None
+        dim_ordering = None
+        for lc in layer_confs:
+            cfg = lc["config"]
+            dim_ordering = cfg.get("dim_ordering", dim_ordering)
+        for i, lc in enumerate(layer_confs):
+            cls, cfg = lc["class_name"], lc["config"]
+            name = cfg.get("name") or cfg.get("layer_name") or f"layer_{i}"
+            if input_type is None:
+                it = _keras_input_type(cfg, dim_ordering or "tf")
+                if it is not None:
+                    input_type = it
+            conv = _convert_layer(cls, cfg, _layer_weights(wgroup, name),
+                                  dim_ordering)
+            if conv is not None:
+                imported.append(conv)
+
+        # last Dense becomes OutputLayer (reference KerasLoss handling)
+        last = imported[-1]
+        if isinstance(last.conf_layer, DenseLayer):
+            d = last.conf_layer
+            imported[-1] = _ImportedLayer(
+                OutputLayer(n_out=d.n_out, activation=d.activation or
+                            "softmax",
+                            loss="mcxent" if (d.activation == "softmax")
+                            else "mse"),
+                last.params)
+        for il in imported:
+            builder.layer(il.conf_layer)
+        if input_type is not None:
+            builder.set_input_type(input_type)
+        net = MultiLayerNetwork(builder.build()).init()
+        _copy_params_mln(net, imported)
+        return net
+
+
+def _copy_params_mln(net: MultiLayerNetwork, imported) -> None:
+    import jax.numpy as jnp
+    for i, il in enumerate(imported):
+        if il.params:
+            for k, v in il.params.items():
+                net.params[i][k] = jnp.asarray(
+                    np.asarray(v), net.params[i][k].dtype).reshape(
+                        net.params[i][k].shape)
+        for k, v in (il.state or {}).items():
+            if v is not None and k in net.net_state[i]:
+                net.net_state[i][k] = jnp.asarray(
+                    np.asarray(v), net.net_state[i][k].dtype)
+
+
+def import_keras_model_and_weights(path: str,
+                                   train_config: bool = False
+                                   ) -> ComputationGraph:
+    """Reference ``KerasModelImport.importKerasModelAndWeights``: Keras 1.x
+    functional-API .h5 -> ComputationGraph."""
+    import jax.numpy as jnp
+    with _open(path) as f:
+        conf = _model_config(f)
+        if conf["class_name"] not in ("Model", "Functional"):
+            raise ValueError("Not a functional-API model")
+        mc = conf["config"]
+        layer_confs = mc["layers"]
+        wgroup = _weights_group(f)
+
+        dim_ordering = None
+        for lc in layer_confs:
+            dim_ordering = lc["config"].get("dim_ordering", dim_ordering)
+
+        g = (NeuralNetConfiguration.builder().updater("sgd")
+             .activation("identity").weight_init("xavier").graph_builder())
+        input_names = [l[0] for l in mc["input_layers"]]
+        output_names = [l[0] for l in mc["output_layers"]]
+        input_types = []
+        imported: Dict[str, _ImportedLayer] = {}
+        passthrough: Dict[str, str] = {}  # flatten-like no-op mapping
+
+        def resolve(name: str) -> str:
+            while name in passthrough:
+                name = passthrough[name]
+            return name
+
+        for lc in layer_confs:
+            cls, cfg = lc["class_name"], lc["config"]
+            name = lc.get("name") or cfg.get("name")
+            inbound = lc.get("inbound_nodes") or []
+            # keras1 inbound_nodes: [[[name, node_idx, tensor_idx], ...]]
+            in_names = ([resolve(x[0]) for x in inbound[0]]
+                        if inbound else [])
+            if cls == "InputLayer":
+                input_types.append(
+                    _keras_input_type(cfg, dim_ordering or "tf"))
+                continue
+            if cls == "Flatten":
+                passthrough[name] = in_names[0]
+                continue
+            if cls == "Merge":
+                mode = cfg.get("mode", "concat")
+                if mode == "concat":
+                    g.add_vertex(name, MergeVertex(), *in_names)
+                elif mode == "sum":
+                    g.add_vertex(name, ElementWiseVertex(op="add"),
+                                 *in_names)
+                else:
+                    raise ValueError(f"Unsupported Merge mode '{mode}'")
+                continue
+            conv = _convert_layer(cls, cfg, _layer_weights(wgroup, name),
+                                  dim_ordering)
+            if conv is None:
+                passthrough[name] = in_names[0]
+                continue
+            if name in output_names and isinstance(conv.conf_layer,
+                                                   DenseLayer):
+                d = conv.conf_layer
+                conv = _ImportedLayer(
+                    OutputLayer(n_out=d.n_out,
+                                activation=d.activation or "softmax",
+                                loss="mcxent" if d.activation == "softmax"
+                                else "mse"), conv.params, conv.state)
+            imported[name] = conv
+            g.add_layer(name, conv.conf_layer, *in_names)
+
+        g.add_inputs(*input_names)
+        g.set_outputs(*[resolve(n) for n in output_names])
+        if all(t is not None for t in input_types) and input_types:
+            g.set_input_types(*input_types)
+        cg = ComputationGraph(g.build()).init()
+        for name, il in imported.items():
+            if il.params:
+                for k, v in il.params.items():
+                    cg.params[name][k] = jnp.asarray(
+                        np.asarray(v),
+                        cg.params[name][k].dtype).reshape(
+                            cg.params[name][k].shape)
+            for k, v in (il.state or {}).items():
+                if v is not None and k in cg.net_state[name]:
+                    cg.net_state[name][k] = jnp.asarray(
+                        np.asarray(v), cg.net_state[name][k].dtype)
+        return cg
+
+
+class KerasModelImport:
+    """Namespace mirroring the reference entry points
+    (``KerasModelImport.java:48-156``)."""
+
+    import_keras_sequential_model_and_weights = staticmethod(
+        import_keras_sequential_model_and_weights)
+    import_keras_model_and_weights = staticmethod(
+        import_keras_model_and_weights)

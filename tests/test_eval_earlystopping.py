@@ -1,0 +1,169 @@
+"""ROC / RegressionEvaluation / early-stopping tests, modeled on the
+reference's ``eval/ROCTest.java``, ``eval/RegressionEvalTest.java`` and
+``earlystopping/TestEarlyStopping.java``."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingParallelTrainer, EarlyStoppingTrainer, InMemoryModelSaver,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition, MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+
+# -------------------------------------------------------------------- ROC
+def test_roc_perfect_classifier_auc_one():
+    roc = ROC(threshold_steps=30)
+    y = np.array([0, 0, 0, 1, 1, 1])
+    p = np.array([0.1, 0.2, 0.3, 0.7, 0.8, 0.9])
+    roc.eval(y, p)
+    assert roc.calculate_auc() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_roc_random_classifier_auc_half():
+    rng = np.random.RandomState(0)
+    roc = ROC(threshold_steps=100)
+    y = rng.randint(0, 2, 20000)
+    p = rng.rand(20000)
+    roc.eval(y, p)
+    assert roc.calculate_auc() == pytest.approx(0.5, abs=0.02)
+
+
+def test_roc_one_hot_two_column_convention():
+    roc = ROC()
+    labels = np.array([[1, 0], [0, 1], [1, 0], [0, 1]])
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    roc.eval(labels, probs)
+    assert roc.calculate_auc() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_roc_multiclass_average_auc():
+    rng = np.random.RandomState(1)
+    n = 3000
+    cls = rng.randint(0, 3, n)
+    labels = np.eye(3)[cls]
+    # good but not perfect scores
+    probs = labels * 0.6 + rng.rand(n, 3) * 0.4
+    probs /= probs.sum(1, keepdims=True)
+    roc = ROCMultiClass(threshold_steps=50)
+    roc.eval(labels, probs)
+    for c in range(3):
+        assert roc.calculate_auc(c) > 0.8
+    assert 0.8 < roc.calculate_average_auc() <= 1.0
+
+
+# ------------------------------------------------------------- regression
+def test_regression_evaluation_known_values():
+    ev = RegressionEvaluation(["a", "b"])
+    y = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    p = np.array([[1.5, 2.0], [2.5, 4.5], [5.5, 5.5]])
+    ev.eval(y, p)
+    assert ev.mean_squared_error(0) == pytest.approx(0.25)
+    assert ev.mean_absolute_error(0) == pytest.approx(0.5)
+    assert ev.root_mean_squared_error(1) == pytest.approx(
+        np.sqrt(0.25 / 3 * 2))
+    assert ev.correlation_r2(0) > 0.95
+    assert "RMSE" in ev.stats()
+
+
+def test_regression_evaluation_accumulates_batches():
+    rng = np.random.RandomState(0)
+    y = rng.randn(100, 3)
+    p = y + rng.randn(100, 3) * 0.1
+    ev1 = RegressionEvaluation()
+    ev1.eval(y, p)
+    ev2 = RegressionEvaluation()
+    ev2.eval(y[:50], p[:50])
+    ev2.eval(y[50:], p[50:])
+    for c in range(3):
+        assert ev1.mean_squared_error(c) == pytest.approx(
+            ev2.mean_squared_error(c))
+        assert ev1.r_squared(c) > 0.9
+
+
+# ---------------------------------------------------------- early stopping
+def _toy_iterator(seed=0, n=128, batch=32):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4)
+    Y = np.eye(3)[(X.sum(1) > 0).astype(int)]
+    return ListDataSetIterator(DataSet(X, Y), batch)
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("adam").learning_rate(lr)
+            .activation("relu").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_early_stopping_max_epochs():
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+           .score_calculator(DataSetLossCalculator(_toy_iterator(seed=9)))
+           .model_saver(InMemoryModelSaver()).build())
+    result = EarlyStoppingTrainer(cfg, _net(), _toy_iterator()).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "MaxEpochs" in result.termination_details
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 5
+
+
+def test_early_stopping_score_improvement():
+    # lr=0 -> no improvement ever -> stops after patience epochs
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(
+               MaxEpochsTerminationCondition(50),
+               ScoreImprovementEpochTerminationCondition(2))
+           .score_calculator(DataSetLossCalculator(_toy_iterator(seed=9)))
+           .model_saver(InMemoryModelSaver()).build())
+    result = EarlyStoppingTrainer(cfg, _net(lr=0.0), _toy_iterator()).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs < 50
+
+
+def test_early_stopping_divergence_guard():
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+           .iteration_termination_conditions(
+               MaxScoreIterationTerminationCondition(1e-12))
+           .score_calculator(DataSetLossCalculator(_toy_iterator(seed=9)))
+           .build())
+    result = EarlyStoppingTrainer(cfg, _net(), _toy_iterator()).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(_toy_iterator(seed=9)))
+           .model_saver(LocalFileModelSaver(str(tmp_path)))
+           .save_last_model().build())
+    result = EarlyStoppingTrainer(cfg, _net(), _toy_iterator()).fit()
+    assert (tmp_path / "bestModel.bin").exists()
+    assert (tmp_path / "latestModel.bin").exists()
+    best = result.best_model
+    it = _toy_iterator()
+    assert best.evaluate(it).accuracy() > 0.5
+
+
+def test_early_stopping_parallel_trainer():
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+           .score_calculator(DataSetLossCalculator(_toy_iterator(seed=9)))
+           .model_saver(InMemoryModelSaver()).build())
+    trainer = EarlyStoppingParallelTrainer(
+        cfg, _net(), _toy_iterator(), workers=4, averaging_frequency=1)
+    result = trainer.fit()
+    assert result.total_epochs == 4
+    assert result.best_model_score < 2.0
