@@ -48,6 +48,26 @@ InputType = _inputs.InputType
 Carry = Tuple[Array, Array]
 
 
+def _match_varying(tree, ref: Array):
+    """Pcast every leaf of ``tree`` to carry the same varying manual axes
+    (shard_map vma) as ``ref``.
+
+    Fresh ``jnp.zeros`` carries are unvarying; inside ``shard_map`` (the
+    ParallelWrapper step) the scanned inputs are device-varying, and
+    ``lax.scan`` requires carry-in and carry-out types to match.  Outside
+    shard_map ``ref`` has no vma and this is a no-op."""
+    ref_vma = getattr(jax.typeof(ref), "vma", frozenset())
+    if not ref_vma:
+        return tree
+
+    def cast(leaf):
+        missing = ref_vma - getattr(jax.typeof(leaf), "vma", frozenset())
+        return lax.pcast(leaf, tuple(missing), to="varying") if missing \
+            else leaf
+
+    return jax.tree.map(cast, tree)
+
+
 def lstm_scan(W: Array, RW: Array, b: Array, x: Array, carry: Carry, *,
               afn, gate_fn, mask: Optional[Array] = None,
               reverse: bool = False) -> Tuple[Array, Carry]:
@@ -91,6 +111,7 @@ def lstm_scan(W: Array, RW: Array, b: Array, x: Array, carry: Carry, *,
         return (h_new, c_new), jnp.where(keep, h, 0.0)
 
     xs = xw_t if mask_t is None else (xw_t, mask_t)
+    carry = _match_varying(carry, xw_t)
     final, ys = lax.scan(step, carry, xs, reverse=reverse)
     return jnp.swapaxes(ys, 0, 1), final
 

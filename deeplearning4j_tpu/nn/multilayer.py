@@ -405,10 +405,16 @@ class MultiLayerNetwork:
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None, :]
-        if (self._rnn_carries is None
-                or self._rnn_carry_batch != x.shape[0]):
+        if self._rnn_carries is None:
             self._rnn_carries = self._init_carries(x.shape[0])
             self._rnn_carry_batch = x.shape[0]
+        elif self._rnn_carry_batch != x.shape[0]:
+            # Reference throws DL4JInvalidInputException here — silently
+            # resetting would discard state from a half-fed sequence.
+            raise ValueError(
+                f"rnn_time_step batch size {x.shape[0]} != stored state "
+                f"batch size {self._rnn_carry_batch}; call "
+                "rnn_clear_previous_state() between unrelated sequences")
         out, self._rnn_carries = self._rnn_step_fn(
             self.params, self.net_state, self._rnn_carries, x)
         out = np.asarray(out)

@@ -111,14 +111,18 @@ class ParallelWrapper:
         avg_updaters = self.average_updaters
 
         def local_round(params, updater_state, net_state, iteration,
-                        features, labels, base_rng):
+                        features, labels, fmask, lmask, base_rng):
             # Global shapes: batches (avg_freq, workers, batch, ...) and
             # updater state (workers, ...); this worker's view carries a
             # leading worker axis of size 1 — drop it.  features/labels are
             # single arrays for MultiLayerNetwork, tuples of arrays for
-            # ComputationGraph.
+            # ComputationGraph; masks are None (empty pytree) or shaped like
+            # batches — the reference trains with full DataSet masks, so
+            # they thread through to _loss_fn.
             features = jax.tree.map(lambda a: a[:, 0], features)
             labels = jax.tree.map(lambda a: a[:, 0], labels)
+            fmask = jax.tree.map(lambda a: a[:, 0], fmask)
+            lmask = jax.tree.map(lambda a: a[:, 0], lmask)
             updater_state = jax.tree.map(lambda a: a[0], updater_state)
             widx = lax.axis_index("data")
             # Mark replicated state as device-varying: each worker steps its
@@ -126,16 +130,17 @@ class ParallelWrapper:
             # tracking auto-psums gradients taken w.r.t. unvarying params
             # (allreduce-SGD), which is NOT the reference's local-step-then-
             # average semantics.
-            params, net_state = lax.pvary((params, net_state), "data")
+            params, net_state = lax.pcast((params, net_state), "data",
+                                          to="varying")
 
             def one_step(carry, batch):
                 params, updater_state, net_state, it = carry
-                f, l = batch
+                f, l, fm, lm = batch
                 rng = jax.random.fold_in(
                     jax.random.fold_in(base_rng, it), widx)
                 (data_loss, aux), grads = jax.value_and_grad(
                     net._loss_fn, has_aux=True)(
-                        params, net_state, f, l, None, None, rng, True)
+                        params, net_state, f, l, fm, lm, rng, True)
                 # MLN aux is (state, carries); CG aux is the state dict
                 new_state = aux[0] if isinstance(aux, tuple) else aux
                 new_params, new_ustate = net._apply_updates(
@@ -145,12 +150,13 @@ class ParallelWrapper:
 
             (params, updater_state, net_state, _), scores = lax.scan(
                 one_step, (params, updater_state, net_state, iteration),
-                (features, labels))
+                (features, labels, fmask, lmask))
             # averageAndPropagate: params always, updater state if enabled
             params = lax.pmean(params, "data")
             if avg_updaters:
                 updater_state = lax.pmean(updater_state, "data")
-                updater_state = lax.pvary(updater_state, "data")
+                updater_state = lax.pcast(updater_state, "data",
+                                          to="varying")
             net_state = lax.pmean(net_state, "data")
             score = lax.pmean(jnp.mean(scores), "data")
             # updater state stays per-worker (stacked) across rounds
@@ -159,7 +165,7 @@ class ParallelWrapper:
 
         mesh = self.mesh
         in_specs = (P(), P("data"), P(), P(), P(None, "data"),
-                    P(None, "data"), P())
+                    P(None, "data"), P(None, "data"), P(None, "data"), P())
         out_specs = (P(), P("data"), P(), P())
         fn = jax.shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
@@ -183,11 +189,12 @@ class ParallelWrapper:
                     self._run_round(pending)
                     pending = []
             if pending:
-                # Tail: pad the round by reusing batches (the reference
-                # simply leaves stragglers to the next fit call; padding
-                # keeps shapes static for XLA)
-                while len(pending) < k * w:
-                    pending.append(pending[len(pending) % max(len(pending), 1)])
+                # Tail: pad the round by cycling through the straggler
+                # batches (the reference simply leaves stragglers to the next
+                # fit call; padding keeps shapes static for XLA)
+                n = len(pending)
+                for i in range(k * w - n):
+                    pending.append(pending[i % n])
                 self._run_round(pending)
         return self
 
@@ -203,6 +210,19 @@ class ParallelWrapper:
                           for i in range(w)])
                 for j in range(k)])
 
+        def stack_masks(get):
+            # Masks are optional; a round must be uniform (the reference
+            # trains every minibatch with its own masks — a mixed round
+            # can't compile to one static-shape XLA program).
+            present = [get(ds) is not None for ds in batches]
+            if not any(present):
+                return None
+            if not all(present):
+                raise ValueError(
+                    "Mixed mask presence across batches within one "
+                    "averaging round; provide masks on all batches or none")
+            return stack(get)
+
         if self._is_graph:
             from ..nn.computation_graph import _as_multi
             batches = [_as_multi(ds) for ds in batches]
@@ -212,13 +232,29 @@ class ParallelWrapper:
                           for s in range(n_in))
             labs = tuple(stack(lambda m, s=s: m.labels[s])
                          for s in range(n_out))
+            fmask = tuple(stack_masks(
+                lambda m, s=s: None if m.features_masks is None
+                else m.features_masks[s]) for s in range(n_in))
+            lmask = tuple(stack_masks(
+                lambda m, s=s: None if m.labels_masks is None
+                else m.labels_masks[s]) for s in range(n_out))
+            if all(m is None for m in fmask):
+                fmask = None
+            if all(m is None for m in lmask):
+                lmask = None
         else:
             feats = stack(lambda ds: ds.features)
             labs = stack(lambda ds: ds.labels)
+            fmask = stack_masks(lambda ds: ds.features_mask)
+            lmask = stack_masks(lambda ds: ds.labels_mask)
         # shard the worker axis (axis 1) over the mesh
         sharding = NamedSharding(self.mesh, P(None, "data"))
         feats = jax.device_put(jax.tree.map(jnp.asarray, feats), sharding)
         labs = jax.device_put(jax.tree.map(jnp.asarray, labs), sharding)
+        if fmask is not None:
+            fmask = jax.device_put(jax.tree.map(jnp.asarray, fmask), sharding)
+        if lmask is not None:
+            lmask = jax.device_put(jax.tree.map(jnp.asarray, lmask), sharding)
         if self._worker_ustate is None:
             # Replicate the model's updater state to every worker (the
             # reference's per-worker model replication at Trainer start).
@@ -231,7 +267,7 @@ class ParallelWrapper:
         (net.params, self._worker_ustate, net.net_state,
          score) = self._parallel_step(
             net.params, self._worker_ustate, net.net_state,
-            net.iteration, feats, labs, net._rng_key)
+            net.iteration, feats, labs, fmask, lmask, net._rng_key)
         # Keep the model's own updater state in sync (worker 0's replica —
         # identical across workers when average_updaters is on).
         net.updater_state = jax.tree.map(lambda a: a[0], self._worker_ustate)

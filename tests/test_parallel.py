@@ -141,3 +141,66 @@ def test_parallel_wrapper_with_computation_graph():
     ref.fit(big)
     np.testing.assert_allclose(cg.get_flat_params(), ref.get_flat_params(),
                                rtol=1e-8)
+
+
+def test_masks_thread_through_parallel_fit():
+    """Sequence DataSets with padding masks must train identically under
+    ParallelWrapper and plain fit (ADVICE r1: masks were dropped)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+
+    def rconf():
+        return (NeuralNetConfiguration.builder().seed(7).dtype("float64")
+                .updater("sgd").learning_rate(0.1).activation("tanh")
+                .weight_init("xavier").list()
+                .layer(GravesLSTM(n_in=3, n_out=5))
+                .layer(RnnOutputLayer(n_in=5, n_out=2)).build())
+
+    rng = np.random.RandomState(11)
+    w = 2
+    batches = []
+    for _ in range(w):
+        f = rng.randn(4, 6, 3)
+        l = np.eye(2)[rng.randint(0, 2, (4, 6))]
+        mask = (rng.rand(4, 6) > 0.3).astype(np.float64)
+        mask[:, 0] = 1.0
+        batches.append(DataSet(f, l, features_mask=mask))
+
+    pw_net = MultiLayerNetwork(rconf()).init()
+    ref_net = MultiLayerNetwork(rconf()).init()
+    ParallelWrapper(pw_net, workers=w, averaging_frequency=1).fit(batches)
+
+    manual = [MultiLayerNetwork(rconf()).init() for _ in range(w)]
+    for m, b in zip(manual, batches):
+        m.fit(b)
+    avg = np.mean([m.get_flat_params() for m in manual], axis=0)
+    np.testing.assert_allclose(pw_net.get_flat_params(), avg, rtol=1e-8)
+    # and it must DIFFER from the unmasked result
+    unmasked = [MultiLayerNetwork(rconf()).init() for _ in range(w)]
+    for m, b in zip(unmasked, batches):
+        m.fit(DataSet(b.features, b.labels))
+    avg_unmasked = np.mean([m.get_flat_params() for m in unmasked], axis=0)
+    assert not np.allclose(np.asarray(pw_net.get_flat_params()), avg_unmasked)
+
+
+def test_mixed_mask_presence_raises():
+    batches = _batches(2)
+    batches[0] = DataSet(batches[0].features, batches[0].labels,
+                         features_mask=np.ones((8, 1)))
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(ValueError, match="[Mm]ixed mask"):
+        ParallelWrapper(net, workers=2, averaging_frequency=1).fit(batches)
+
+
+def test_rnn_time_step_batch_mismatch_raises():
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(7).dtype("float64")
+            .updater("sgd").learning_rate(0.1).activation("tanh")
+            .weight_init("xavier").list()
+            .layer(GravesLSTM(n_in=3, n_out=5))
+            .layer(RnnOutputLayer(n_in=5, n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.rnn_time_step(np.zeros((4, 3)))
+    with pytest.raises(ValueError, match="rnn_clear_previous_state"):
+        net.rnn_time_step(np.zeros((2, 3)))
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(np.zeros((2, 3)))
