@@ -2,15 +2,21 @@
 
 TPU-native equivalent of the reference's
 ``gradientcheck/GradientCheckUtil.java`` (``checkGradients(MLN):76``,
-``checkGradients(ComputationGraph):222``) — the backbone of the reference
-test suite (SURVEY.md §4).  The analytic gradient comes from ``jax.grad`` of
-the network loss; the numerical gradient is a central difference on the flat
-parameter vector in float64 (tests enable ``jax_enable_x64``).
+``checkGradients(ComputationGraph):222``, ``checkGradientsPretrainLayer:362``)
+— the backbone of the reference test suite (SURVEY.md §4).  The analytic
+gradient comes from ``jax.grad`` of the network loss; the numerical gradient
+is a central difference on the flat parameter vector in float64 (tests
+enable ``jax_enable_x64``).
+
+Unlike the reference's per-parameter Java loop (two forward passes per
+param, each a blocking call), the central differences here are *vmapped*:
+chunks of perturbation indices evaluate as one batched XLA program, so
+checking every parameter of a real layer stack is tractable on TPU/CPU.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +25,89 @@ import numpy as np
 DEFAULT_EPS = 1e-6
 DEFAULT_MAX_REL_ERROR = 1e-3
 DEFAULT_MIN_ABS_ERROR = 1e-8
+_CHUNK = 128
+
+
+def _run_check(loss_flat: Callable, flat0: np.ndarray, analytic: np.ndarray,
+               idxs: np.ndarray, eps: float, max_rel_error: float,
+               min_abs_error: float, print_results: bool,
+               label: str) -> bool:
+    """Shared compare loop: batched central differences vs analytic grads.
+
+    ``loss_flat`` maps a float64 flat param vector to the scalar total loss.
+    """
+    flat = jnp.asarray(flat0)
+
+    @jax.jit
+    def chunk_numeric(chunk_idxs):
+        def one(j):
+            f_plus = loss_flat(flat.at[j].add(eps))
+            f_minus = loss_flat(flat.at[j].add(-eps))
+            return (f_plus - f_minus) / (2.0 * eps)
+        return jax.vmap(one)(chunk_idxs)
+
+    numeric = np.empty(idxs.size, np.float64)
+    for start in range(0, idxs.size, _CHUNK):
+        chunk = idxs[start:start + _CHUNK]
+        pad = _CHUNK - chunk.size
+        padded = np.concatenate([chunk, np.zeros(pad, chunk.dtype)]) \
+            if pad else chunk
+        vals = np.asarray(chunk_numeric(jnp.asarray(padded)))
+        numeric[start:start + chunk.size] = vals[:chunk.size]
+
+    a = analytic[idxs]
+    denom = np.abs(a) + np.abs(numeric)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rel = np.where(denom == 0, 0.0, np.abs(a - numeric) / denom)
+    fails = (rel > max_rel_error) & (np.abs(a - numeric) > min_abs_error)
+    n_fail = int(fails.sum())
+    max_err = float(rel.max()) if rel.size else 0.0
+    if print_results:
+        for pos in np.nonzero(fails)[0][:50]:
+            print(f"param {idxs[pos]}: analytic={a[pos]:.8g} "
+                  f"numeric={numeric[pos]:.8g} rel={rel[pos]:.4g} FAIL")
+        print(f"GradientCheck({label}): {idxs.size - n_fail} passed, "
+              f"{n_fail} failed (maxRelError={max_err:.4g})")
+    return n_fail == 0
+
+
+def _make_unravel(template, entries: Sequence[Tuple]):
+    """Build (flatten, unravel) for a params container.
+
+    ``entries`` is the deterministic flat ordering: (container_key,
+    param_name) pairs.  ``unravel`` is traceable (used inside jit/vmap).
+    """
+    metas = []
+    for ck, pk in entries:
+        leaf = template[ck][pk]
+        metas.append((ck, pk, leaf.shape, leaf.dtype,
+                      int(np.prod(leaf.shape)) if leaf.shape else 1))
+
+    def flatten_tree(tree) -> np.ndarray:
+        parts = [np.asarray(tree[ck][pk]).ravel() for ck, pk, *_ in metas]
+        return (np.concatenate(parts) if parts
+                else np.zeros((0,), np.float64))
+
+    def unravel(flat):
+        if isinstance(template, list):
+            out = [dict(d) for d in template]
+        else:
+            out = {k: dict(v) for k, v in template.items()}
+        off = 0
+        for ck, pk, shape, dtype, n in metas:
+            out[ck][pk] = flat[off:off + n].reshape(shape).astype(dtype)
+            off += n
+        return out
+
+    return flatten_tree, unravel
+
+
+def _subset(n: int, subset: Optional[int], seed: int) -> np.ndarray:
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.sort(np.random.RandomState(seed).choice(
+            n, subset, replace=False))
+    return idxs
 
 
 def check_gradients(net, dataset, eps: float = DEFAULT_EPS,
@@ -27,14 +116,7 @@ def check_gradients(net, dataset, eps: float = DEFAULT_EPS,
                     print_results: bool = False,
                     subset: Optional[int] = None,
                     seed: int = 0) -> bool:
-    """Compare analytic vs numerical gradients of the total score.
-
-    Mirrors ``GradientCheckUtil.checkGradients``: perturb each flat param
-    +/-eps, compare (f(p+) - f(p-)) / 2eps against the analytic gradient with
-    a relative-error threshold; ``min_abs_error`` forgives tiny absolute
-    differences (reference semantics).  ``subset`` randomly samples that many
-    params for large nets.
-    """
+    """MultiLayerNetwork check (reference ``checkGradients(MLN):76``)."""
     net.init()
     features = jnp.asarray(dataset.features)
     labels = jnp.asarray(dataset.labels)
@@ -43,64 +125,21 @@ def check_gradients(net, dataset, eps: float = DEFAULT_EPS,
     lmask = (None if dataset.labels_mask is None
              else jnp.asarray(dataset.labels_mask))
 
-    def total_loss_fn(params):
+    entries = [(i, name) for i, layer in enumerate(net.layers)
+               for name in layer.param_order()]
+    flatten_tree, unravel = _make_unravel(net.params, entries)
+
+    def total_loss(params):
         data_loss, _ = net._loss_fn(params, net.net_state, features, labels,
                                     fmask, lmask, None, False)
         return data_loss + net._reg_score(params)
 
-    # One compile, then each central-difference evaluation is a fast cached
-    # call (matters for scan-heavy RNN graphs where eager eval is slow).
-    total_loss = jax.jit(total_loss_fn)
-
-    analytic_tree = jax.grad(total_loss_fn)(net.params)
-
-    # Flatten analytic grads in the same deterministic order as flat params.
-    analytic = []
-    for i, layer in enumerate(net.layers):
-        for name in layer.param_order():
-            analytic.append(np.asarray(analytic_tree[i][name]).ravel())
-    analytic = (np.concatenate(analytic) if analytic
-                else np.zeros((0,), np.float64))
-
-    flat0 = net.get_flat_params().astype(np.float64)
-    n = flat0.size
-    idxs = np.arange(n)
-    if subset is not None and subset < n:
-        idxs = np.random.RandomState(seed).choice(n, subset, replace=False)
-
-    def loss_at(flat) -> float:
-        net.set_flat_params(flat)
-        return float(total_loss(net.params))
-
-    n_pass = n_fail = 0
-    max_err = 0.0
-    try:
-        for j in idxs:
-            orig = flat0[j]
-            flat0[j] = orig + eps
-            f_plus = loss_at(flat0)
-            flat0[j] = orig - eps
-            f_minus = loss_at(flat0)
-            flat0[j] = orig
-            numeric = (f_plus - f_minus) / (2.0 * eps)
-            a = float(analytic[j])
-            denom = abs(a) + abs(numeric)
-            rel = 0.0 if denom == 0 else abs(a - numeric) / denom
-            if rel > max_rel_error and abs(a - numeric) > min_abs_error:
-                n_fail += 1
-                if print_results:
-                    print(f"param {j}: analytic={a:.8g} numeric={numeric:.8g} "
-                          f"rel={rel:.4g} FAIL")
-            else:
-                n_pass += 1
-            max_err = max(max_err, rel)
-    finally:
-        net.set_flat_params(flat0)
-
-    if print_results:
-        print(f"GradientCheck: {n_pass} passed, {n_fail} failed "
-              f"(maxRelError={max_err:.4g})")
-    return n_fail == 0
+    analytic = flatten_tree(jax.grad(total_loss)(net.params))
+    flat0 = flatten_tree(net.params).astype(np.float64)
+    idxs = _subset(flat0.size, subset, seed)
+    return _run_check(lambda f: total_loss(unravel(f)), flat0, analytic,
+                      idxs, eps, max_rel_error, min_abs_error, print_results,
+                      "MLN")
 
 
 def check_gradients_graph(net, mds, eps: float = DEFAULT_EPS,
@@ -111,7 +150,8 @@ def check_gradients_graph(net, mds, eps: float = DEFAULT_EPS,
                           seed: int = 0) -> bool:
     """ComputationGraph variant (reference
     ``GradientCheckUtil.checkGradients(ComputationGraph):222``)."""
-    from .datasets.dataset import DataSet, MultiDataSet
+    from .datasets.dataset import DataSet
+
     net.init()
     if isinstance(mds, DataSet):
         from .nn.computation_graph import _as_multi
@@ -123,57 +163,64 @@ def check_gradients_graph(net, mds, eps: float = DEFAULT_EPS,
     lmasks = (None if mds.labels_masks is None else tuple(
         None if m is None else jnp.asarray(m) for m in mds.labels_masks))
 
-    def total_loss_fn(params):
+    entries = [(name, p) for name in net._layer_names()
+               for p in net.vertices[name].layer.param_order()]
+    flatten_tree, unravel = _make_unravel(net.params, entries)
+
+    def total_loss(params):
         data_loss, _ = net._loss_fn(params, net.net_state, features, labels,
                                     fmasks, lmasks, None, False)
         return data_loss + net._reg_score(params)
 
-    total_loss = jax.jit(total_loss_fn)
-    analytic_tree = jax.grad(total_loss_fn)(net.params)
+    analytic = flatten_tree(jax.grad(total_loss)(net.params))
+    flat0 = flatten_tree(net.params).astype(np.float64)
+    idxs = _subset(flat0.size, subset, seed)
+    return _run_check(lambda f: total_loss(unravel(f)), flat0, analytic,
+                      idxs, eps, max_rel_error, min_abs_error, print_results,
+                      "graph")
 
-    analytic = []
-    for name in net._layer_names():
-        for p in net.vertices[name].layer.param_order():
-            analytic.append(np.asarray(analytic_tree[name][p]).ravel())
-    analytic = (np.concatenate(analytic) if analytic
-                else np.zeros((0,), np.float64))
 
-    flat0 = net.get_flat_params().astype(np.float64)
-    n = flat0.size
-    idxs = np.arange(n)
-    if subset is not None and subset < n:
-        idxs = np.random.RandomState(seed).choice(n, subset, replace=False)
+def check_pretrain_gradients(net, dataset, layer_idx: int,
+                             eps: float = DEFAULT_EPS,
+                             max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+                             min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+                             print_results: bool = False,
+                             subset: Optional[int] = None,
+                             rng_seed: int = 42) -> bool:
+    """Unsupervised-loss check for one layer (reference
+    ``checkGradientsPretrainLayer:362``).
 
-    def loss_at(flat) -> float:
-        net.set_flat_params(flat)
-        return float(total_loss(net.params))
+    The MC sampling rng is held fixed so the loss is a deterministic
+    function of the params (the reference fixes Nd4j's rng the same way in
+    ``VaeGradientCheckTests``).  Only valid for layers whose
+    ``pretrain_grads`` is the exact gradient of ``pretrain_loss`` (VAE /
+    AutoEncoder); RBM contrastive divergence is not a loss gradient.
+    """
+    from .nn import updaters as _updaters
 
-    n_pass = n_fail = 0
-    max_err = 0.0
-    try:
-        for j in idxs:
-            orig = flat0[j]
-            flat0[j] = orig + eps
-            f_plus = loss_at(flat0)
-            flat0[j] = orig - eps
-            f_minus = loss_at(flat0)
-            flat0[j] = orig
-            numeric = (f_plus - f_minus) / (2.0 * eps)
-            a = float(analytic[j])
-            denom = abs(a) + abs(numeric)
-            rel = 0.0 if denom == 0 else abs(a - numeric) / denom
-            if rel > max_rel_error and abs(a - numeric) > min_abs_error:
-                n_fail += 1
-                if print_results:
-                    print(f"param {j}: analytic={a:.8g} "
-                          f"numeric={numeric:.8g} rel={rel:.4g} FAIL")
-            else:
-                n_pass += 1
-            max_err = max(max_err, rel)
-    finally:
-        net.set_flat_params(flat0)
+    net.init()
+    layer = net.layers[layer_idx]
+    features = jnp.asarray(dataset.features)
+    rng = jax.random.PRNGKey(rng_seed)
+    x, _, _ = net._forward(net.params, net.net_state, features, train=False,
+                           rng=None, to_layer=layer_idx - 1)
+    if layer_idx in net.conf.input_preprocessors:
+        x = net.conf.input_preprocessors[layer_idx](x)
 
-    if print_results:
-        print(f"GradientCheck(graph): {n_pass} passed, {n_fail} failed "
-              f"(maxRelError={max_err:.4g})")
-    return n_fail == 0
+    # Wrap the single layer's params as a one-entry container so the shared
+    # unravel machinery applies.
+    entries = [(0, name) for name in layer.param_order()]
+    template = [net.params[layer_idx]]
+    flatten_tree, unravel = _make_unravel(template, entries)
+
+    def total_loss(p_i):
+        return (layer.pretrain_loss(p_i, x, rng)
+                + _updaters.regularization_score(p_i, layer.l1_by_param(),
+                                                 layer.l2_by_param()))
+
+    analytic = flatten_tree([jax.grad(total_loss)(net.params[layer_idx])])
+    flat0 = flatten_tree(template).astype(np.float64)
+    idxs = _subset(flat0.size, subset, rng_seed)
+    return _run_check(lambda f: total_loss(unravel(f)[0]), flat0, analytic,
+                      idxs, eps, max_rel_error, min_abs_error, print_results,
+                      f"pretrain layer {layer_idx}")

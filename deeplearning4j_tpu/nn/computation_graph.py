@@ -58,6 +58,8 @@ class ComputationGraph:
         self._init_done = False
         self._score = float("nan")
         self._rng_key: Optional[jax.Array] = None
+        self._pretrain_step_cache: Dict[str, Any] = {}
+        self._pretrain_done = False
 
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
@@ -168,11 +170,25 @@ class ComputationGraph:
             input_masks=input_masks, preoutput_outputs=True)
         total = jnp.asarray(0.0, jnp.float32)
         for i, out_name in enumerate(self.conf.network_outputs):
-            layer = self.vertices[out_name].layer
+            v = self.vertices[out_name]
+            layer = v.layer
+            lmask = None if labels_masks is None else labels_masks[i]
+            if getattr(layer, "NEEDS_INPUT_FOR_SCORE", False):
+                # Center-loss-style heads score against their input
+                # activations; those are already in the DAG's acts.
+                x = acts[v.inputs[0]]
+                if v.preprocessor is not None:
+                    x = v.preprocessor(x)
+                if layer.dropout and train and rng is not None:
+                    x = layer.apply_dropout(
+                        x, train, jax.random.fold_in(rng, 100_000 + i))
+                total = total + layer.compute_score_with_input(
+                    params[out_name], labels[i], x, lmask,
+                    average=self.conf.conf.mini_batch)
+                continue
             if not hasattr(layer, "compute_score"):
                 raise ValueError(
                     f"Output vertex '{out_name}' is not an output layer")
-            lmask = None if labels_masks is None else labels_masks[i]
             total = total + layer.compute_score(
                 labels[i], acts[out_name], lmask,
                 average=self.conf.conf.mini_batch)
@@ -248,10 +264,87 @@ class ComputationGraph:
             return data_loss + self._reg_score(params)
         return jax.jit(score)
 
+    # -------------------------------------------------------------- pretrain
+    def _pretrain_step(self, name: str):
+        """Jitted unsupervised step for one layer vertex (reference
+        ``ComputationGraph.pretrain:510-555``)."""
+        if name not in self._pretrain_step_cache:
+            v = self.vertices[name]
+            layer = v.layer
+            uconf = self._updater_conf(name)
+
+            def step(params, ustate, net_state, iteration, features,
+                     base_rng):
+                rng = jax.random.fold_in(base_rng, iteration)
+                acts, _ = self._forward(params, net_state, features,
+                                        train=False, rng=None)
+                x = acts[v.inputs[0]]
+                if v.preprocessor is not None:
+                    x = v.preprocessor(x)
+                x = jax.lax.stop_gradient(x)
+                score, grads = layer.pretrain_grads(params[name], x, rng)
+                grads = _updaters.regularize(grads, params[name],
+                                             layer.l1_by_param(),
+                                             layer.l2_by_param())
+                grads = _updaters.normalize_gradients(
+                    grads, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold)
+                updates, new_ustate = _updaters.compute_update(
+                    uconf, grads, ustate, iteration)
+                new_p = jax.tree.map(lambda p, u: p - u, params[name],
+                                     updates)
+                score = score + _updaters.regularization_score(
+                    params[name], layer.l1_by_param(), layer.l2_by_param())
+                return new_p, new_ustate, score
+
+            self._pretrain_step_cache[name] = jax.jit(step,
+                                                      donate_argnums=(1,))
+        return self._pretrain_step_cache[name]
+
+    def pretrain(self, data, epochs: int = 1) -> "ComputationGraph":
+        """Greedy layer-wise pretraining of every pretrainable layer vertex
+        in topological order (reference ``ComputationGraph.pretrain:510``)."""
+        self.init()
+        if not isinstance(data, (DataSet, MultiDataSet)) \
+                and not hasattr(data, "reset"):
+            data = list(data)  # one-shot iterable: each layer needs a pass
+        for name in self._layer_names():
+            if getattr(self.vertices[name].layer, "IS_PRETRAINABLE", False):
+                self.pretrain_layer(name, data, epochs)
+        return self
+
+    def pretrain_layer(self, name: str, data,
+                       epochs: int = 1) -> "ComputationGraph":
+        self.init()
+        if not getattr(self.vertices[name].layer, "IS_PRETRAINABLE", False):
+            return self
+        step = self._pretrain_step(name)
+        batches = ([data] if isinstance(data, (DataSet, MultiDataSet))
+                   else data)
+        for _ in range(epochs):
+            if hasattr(batches, "reset"):
+                batches.reset()
+            for ds in batches:
+                mds = _as_multi(ds)
+                features = tuple(jnp.asarray(f) for f in mds.features)
+                (self.params[name], self.updater_state[name],
+                 score) = step(self.params, self.updater_state[name],
+                               self.net_state, self.iteration, features,
+                               self._rng_key)
+                self._score = score
+                self.iteration += 1
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+        return self
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1) -> "ComputationGraph":
         """Train (reference ``fit`` variants ``:650-810``).  ``data`` may be
-        a (Multi)DataSet, an iterator of them, or features with ``labels``."""
+        a (Multi)DataSet, an iterator of them, or features with ``labels``.
+
+        With ``conf.pretrain=True`` the first call pretrains every
+        pretrainable layer vertex; ``conf.backprop=False`` skips the
+        supervised phase (reference ``fit:740`` + ``pretrain:510``)."""
         self.init()
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
@@ -261,6 +354,16 @@ class ComputationGraph:
         else:
             iterator = data
             batches = None
+        if self.conf.pretrain and not self._pretrain_done:
+            if batches is None and not hasattr(iterator, "reset"):
+                # One-shot iterable: materialize so layer-wise pretraining
+                # and the supervised phase each see the full data.
+                batches = list(iterator)
+                iterator = None
+            self.pretrain(batches if batches is not None else iterator)
+            self._pretrain_done = True
+        if not getattr(self.conf, "backprop", True):
+            return self
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -442,4 +545,5 @@ class ComputationGraph:
         other.net_state = jax.tree.map(jnp.copy, self.net_state)
         other.updater_state = jax.tree.map(jnp.copy, self.updater_state)
         other.iteration = self.iteration
+        other._pretrain_done = self._pretrain_done
         return other

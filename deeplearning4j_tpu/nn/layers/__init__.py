@@ -11,4 +11,7 @@ from . import convolution  # noqa: F401
 from . import core  # noqa: F401
 from . import normalization  # noqa: F401
 from . import pooling  # noqa: F401
+from . import pretrain  # noqa: F401
 from . import recurrent  # noqa: F401
+from . import training  # noqa: F401
+from . import variational  # noqa: F401

@@ -1,0 +1,229 @@
+"""Unsupervised pretraining layers: AutoEncoder and RBM.
+
+TPU-native equivalents of the reference's
+``nn/layers/feedforward/autoencoder/AutoEncoder.java`` and
+``nn/layers/feedforward/rbm/RBM.java`` with param layout from
+``nn/params/PretrainParamInitializer.java`` (keys ``W``, ``b``, ``vb`` —
+the ``vb`` visible bias exists only for the unsupervised phase).
+
+Design: each pretrainable layer exposes
+
+- ``forward`` — the supervised-phase behavior (encode / propUp), identical
+  to a DenseLayer with the layer's activation: used when the layer sits
+  inside a backprop network;
+- ``pretrain_grads(params, x, rng) -> (score, grads)`` — one unsupervised
+  step's loss and parameter gradients, consumed by
+  ``MultiLayerNetwork.pretrain`` (reference ``MultiLayerNetwork.java:991``)
+  inside a jitted XLA step.
+
+For the AutoEncoder the gradients are exact ``jax.grad`` of the
+reconstruction loss (the reference hand-derives the same for its
+sigmoid/cross-entropy default at ``AutoEncoder.java:120-135``); for the RBM
+contrastive divergence is not the gradient of any loss, so ``pretrain_grads``
+computes the CD-k statistics explicitly (reference
+``RBM.java:101-190`` ``contrastiveDivergence``/``computeGradientAndScore``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _activations
+from .. import lossfunctions as _losses
+from ..conf import serde
+from ..weights import init_weights
+from .base import Array, FeedForwardLayerConfig, ParamTree, StateTree
+
+
+@dataclasses.dataclass
+class BasePretrainLayer(FeedForwardLayerConfig):
+    """Shared contract (reference ``nn/layers/BasePretrainNetwork.java`` +
+    ``nn/conf/layers/BasePretrainNetwork.java``)."""
+
+    IS_PRETRAINABLE = True
+
+    loss: str = "xent"  # reconstruction loss (RECONSTRUCTION_CROSSENTROPY)
+    visible_bias_init: float = 0.0
+
+    def param_order(self) -> tuple[str, ...]:
+        return ("W", "b", "vb")
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": init_weights(kw, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init or 0.0, dtype),
+            "vb": jnp.full((self.n_in,), self.visible_bias_init, dtype),
+        }
+
+    def l1_by_param(self):
+        return {k: ((self.l1_bias if k in ("b", "vb") else self.l1) or 0.0)
+                for k in self.param_order()}
+
+    def l2_by_param(self):
+        return {k: ((self.l2_bias if k in ("b", "vb") else self.l2) or 0.0)
+                for k in self.param_order()}
+
+    # -- supervised phase: encode only (reference ``activate`` = propUp) ---
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        x = self.apply_dropout(x, train, rng)
+        return self._activate(x @ params["W"] + params["b"]), state
+
+    # -- unsupervised phase ------------------------------------------------
+    def pretrain_loss(self, params: ParamTree, x: Array,
+                      rng: Optional[jax.Array]) -> Array:
+        raise NotImplementedError
+
+    def pretrain_grads(self, params: ParamTree, x: Array,
+                       rng: Optional[jax.Array]):
+        return jax.value_and_grad(self.pretrain_loss)(params, x, rng)
+
+
+@serde.register("autoencoder")
+@dataclasses.dataclass
+class AutoEncoder(BasePretrainLayer):
+    """Denoising autoencoder (reference ``nn/conf/layers/AutoEncoder.java``:
+    ``corruptionLevel`` default 3e-1, ``sparsity``;
+    ``nn/layers/feedforward/autoencoder/AutoEncoder.java``).
+
+    encode: ``act(x W + b)``; decode: ``act(y W^T + vb)`` (tied weights, like
+    the reference).  Pretrain loss is the configured reconstruction loss of
+    decode(encode(corrupt(x))) against the *clean* input; corruption is
+    masking noise (inputs zeroed with probability ``corruption_level``,
+    reference ``getCorruptedInput``).
+    """
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+
+    def encode(self, params: ParamTree, x: Array) -> Array:
+        return self._activate(x @ params["W"] + params["b"])
+
+    def decode_preact(self, params: ParamTree, y: Array) -> Array:
+        return y @ params["W"].T + params["vb"]
+
+    def decode(self, params: ParamTree, y: Array) -> Array:
+        return self._activate(self.decode_preact(params, y))
+
+    def reconstruct(self, params: ParamTree, x: Array) -> Array:
+        return self.decode(params, self.encode(params, x))
+
+    def pretrain_loss(self, params: ParamTree, x: Array,
+                      rng: Optional[jax.Array]) -> Array:
+        corrupted = x
+        if self.corruption_level > 0:
+            if rng is None:
+                raise ValueError("denoising AutoEncoder needs an rng key")
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        y = self.encode(params, corrupted)
+        pre_z = self.decode_preact(params, y)
+        loss = _losses.score(self.loss, x, pre_z, self.activation or "sigmoid",
+                             None, True)
+        if self.sparsity > 0:
+            # KL(sparsity || mean activation) penalty on hidden units
+            rho_hat = jnp.clip(jnp.mean(y, axis=0), 1e-7, 1 - 1e-7)
+            rho = self.sparsity
+            loss = loss + jnp.sum(rho * jnp.log(rho / rho_hat)
+                                  + (1 - rho) * jnp.log((1 - rho)
+                                                        / (1 - rho_hat)))
+        return loss
+
+
+@serde.register("rbm")
+@dataclasses.dataclass
+class RBM(BasePretrainLayer):
+    """Restricted Boltzmann machine trained by CD-k (reference
+    ``nn/conf/layers/RBM.java`` — HiddenUnit/VisibleUnit enums, ``k`` —
+    and ``nn/layers/feedforward/rbm/RBM.java`` ``contrastiveDivergence``).
+
+    Units: hidden ``binary`` (sigmoid probabilities, Bernoulli samples) or
+    ``rectified``; visible ``binary`` or ``gaussian`` (identity mean,
+    unit-variance noise).  The supervised-phase forward is propUp with the
+    layer activation, like the reference.
+    """
+
+    hidden_unit: str = "binary"
+    visible_unit: str = "binary"
+    k: int = 1
+    sparsity: float = 0.0
+
+    activation: Optional[str] = "sigmoid"
+
+    def prop_up(self, params: ParamTree, v: Array) -> Array:
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "binary":
+            return jax.nn.sigmoid(pre)
+        if self.hidden_unit == "rectified":
+            return jax.nn.relu(pre)
+        raise ValueError(f"Unsupported hidden unit {self.hidden_unit!r}")
+
+    def prop_down_pre(self, params: ParamTree, h: Array) -> Array:
+        return h @ params["W"].T + params["vb"]
+
+    def prop_down(self, params: ParamTree, h: Array) -> Array:
+        pre = self.prop_down_pre(params, h)
+        if self.visible_unit == "binary":
+            return jax.nn.sigmoid(pre)
+        if self.visible_unit == "gaussian":
+            return pre
+        raise ValueError(f"Unsupported visible unit {self.visible_unit!r}")
+
+    def _sample_h(self, rng, hprob: Array) -> Array:
+        if self.hidden_unit == "binary":
+            return jax.random.bernoulli(rng, hprob).astype(hprob.dtype)
+        # rectified: N(mean, sigmoid(mean)) clipped at 0 (reference
+        # RBM.java sampleHiddenGivenVisible RECTIFIED branch)
+        noise = jax.random.normal(rng, hprob.shape, hprob.dtype)
+        return jax.nn.relu(hprob + noise * jnp.sqrt(
+            jax.nn.sigmoid(hprob) + 1e-8))
+
+    def _sample_v(self, rng, vprob: Array) -> Array:
+        if self.visible_unit == "binary":
+            return jax.random.bernoulli(rng, vprob).astype(vprob.dtype)
+        return vprob + jax.random.normal(rng, vprob.shape, vprob.dtype)
+
+    def pretrain_grads(self, params: ParamTree, x: Array,
+                       rng: Optional[jax.Array]):
+        if rng is None:
+            raise ValueError("RBM contrastive divergence needs an rng key")
+        batch = x.shape[0]
+        hprob0 = self.prop_up(params, x)
+        keys = jax.random.split(rng, 2 * self.k + 1)
+        hsamp = self._sample_h(keys[0], hprob0)
+        vprob = x
+        hprob = hprob0
+        for step in range(self.k):
+            vprob = self.prop_down(params, hsamp)
+            vsamp = (self._sample_v(keys[2 * step + 1], vprob)
+                     if self.visible_unit == "binary" else vprob)
+            hprob = self.prop_up(params, vsamp)
+            hsamp = self._sample_h(keys[2 * step + 2], hprob)
+        vk, hk = vprob, hprob
+        # Likelihood ascent: Δθ ∝ (positive − negative) statistics; the
+        # updater applies ``p -= update(g)`` so the gradient is the negation.
+        grads = {
+            "W": -(x.T @ hprob0 - vk.T @ hk) / batch,
+            "b": -jnp.mean(hprob0 - hk, axis=0),
+            "vb": -jnp.mean(x - vk, axis=0),
+        }
+        # Monitored score: reconstruction error against the configured loss
+        # (reference setScoreWithZ(negVSamples)).
+        pre_vk = self.prop_down_pre(params, hsamp)
+        act = "sigmoid" if self.visible_unit == "binary" else "identity"
+        score = _losses.score(self.loss if self.visible_unit == "binary"
+                              else "mse", x, pre_vk, act, None, True)
+        return score, grads
+
+    def free_energy(self, params: ParamTree, v: Array) -> Array:
+        """Mean free energy F(v) = -v·vb - sum log(1+e^{vW+b}) (binary)."""
+        pre = v @ params["W"] + params["b"]
+        return jnp.mean(-v @ params["vb"]
+                        - jnp.sum(jax.nn.softplus(pre), axis=-1))

@@ -1,0 +1,109 @@
+"""CenterLossOutputLayer.
+
+TPU-native equivalent of the reference's
+``nn/layers/training/CenterLossOutputLayer.java`` +
+``nn/conf/layers/CenterLossOutputLayer.java`` (builder: ``alpha`` default
+0.05, ``lambda`` default 2e-4, ``gradientCheck`` flag) and
+``nn/params/CenterLossParamInitializer.java`` (param keys W, b, cL — the
+per-class centers, shape (nClasses=n_out, n_in)).
+
+Loss = supervised loss + (lambda/2) * ||features - center_{label}||^2.
+
+The reference updates centers with their own EMA rate ``alpha`` rather than
+the optimizer's learning rate: here that is expressed with a split loss —
+the feature path sees the lambda-scaled term against frozen centers, the
+center path sees an alpha-scaled term against frozen features — so one
+``jax.grad`` produces exactly the reference's two update rules inside the
+same XLA program.  With ``gradient_check=True`` both paths use the exact
+lambda-scaled term (full gradient flow), which is what the numerical
+gradient checker expects (reference ``gradientCheck`` flag).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import lossfunctions as _losses
+from ..conf import serde
+from ..weights import init_weights
+from .base import Array, FeedForwardLayerConfig, ParamTree, StateTree
+
+
+@serde.register("center_loss_output")
+@dataclasses.dataclass
+class CenterLossOutputLayer(FeedForwardLayerConfig):
+    """Output layer with an auxiliary center-loss term pulling each class's
+    penultimate features toward a learned per-class center."""
+
+    # Scoring needs the layer *input* (the features), not just the
+    # preactivation — MultiLayerNetwork._loss_fn routes accordingly.
+    NEEDS_INPUT_FOR_SCORE = True
+
+    activation: str = "softmax"
+    loss: str = "mcxent"
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+    gradient_check: bool = False
+
+    def param_order(self) -> tuple[str, ...]:
+        return ("W", "b", "cL")
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": init_weights(kw, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init or 0.0, dtype),
+            # Centers start at zero (reference CenterLossParamInitializer).
+            "cL": jnp.zeros((self.n_out, self.n_in), dtype),
+        }
+
+    def l1_by_param(self):
+        # Centers are not regularized (reference excludes cL from l1/l2).
+        return {"W": self.l1 or 0.0, "b": self.l1_bias or 0.0, "cL": 0.0}
+
+    def l2_by_param(self):
+        return {"W": self.l2 or 0.0, "b": self.l2_bias or 0.0, "cL": 0.0}
+
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        x = self.apply_dropout(x, train, rng)
+        return self._activate(x @ params["W"] + params["b"]), state
+
+    def pre_output(self, params: ParamTree, x: Array) -> Array:
+        return x @ params["W"] + params["b"]
+
+    def compute_score_with_input(self, params: ParamTree, labels: Array,
+                                 x: Array, mask: Optional[Array] = None,
+                                 average: bool = True) -> Array:
+        preout = self.pre_output(params, x)
+        supervised = _losses.score(self.loss, labels, preout,
+                                   self.activation, mask, average)
+        centers = params["cL"].astype(x.dtype)
+        assigned = labels.astype(x.dtype) @ centers      # (batch, n_in)
+        if self.gradient_check:
+            diff_sq = jnp.sum((x - assigned) ** 2, axis=-1)
+            center_term = 0.5 * self.lambda_ * diff_sq
+        else:
+            # Split paths: lambda-scaled pull on features (centers frozen),
+            # alpha-scaled pull on centers (features frozen) — one jax.grad
+            # yields the reference's asymmetric update rules.
+            feat_term = 0.5 * self.lambda_ * jnp.sum(
+                (x - jax.lax.stop_gradient(assigned)) ** 2, axis=-1)
+            cent_term = 0.5 * self.alpha * jnp.sum(
+                (jax.lax.stop_gradient(x) - assigned) ** 2, axis=-1)
+            # Report only the lambda term in the score; the alpha term is a
+            # gradient carrier whose value is excluded via stop_gradient
+            # algebra below.
+            center_term = feat_term + cent_term \
+                - jax.lax.stop_gradient(cent_term)
+        if mask is not None:
+            m = mask.reshape(center_term.shape)
+            center_term = center_term * m
+        total_center = (jnp.mean(center_term) if average
+                        else jnp.sum(center_term))
+        return supervised + total_center

@@ -1,0 +1,389 @@
+"""Variational autoencoder layer + pluggable reconstruction distributions.
+
+TPU-native equivalent of the reference's
+``nn/layers/variational/VariationalAutoencoder.java`` (1063 LoC) and the
+distribution classes under ``nn/conf/layers/variational/``:
+Gaussian/Bernoulli/Exponential/Composite/LossFunctionWrapper.
+
+Semantics (reference ``computeGradientAndScore`` at
+``VariationalAutoencoder.java:101-200``):
+
+- encoder MLP -> preactivations of q(z|x) mean and log sigma^2 (two heads
+  off the last encoder activation; ``pzxActivationFn`` applied to both);
+- score = KL[q(z|x) || N(0, I)] (analytic, computed once)
+  + (1/numSamples) * sum over MC samples of the reconstruction
+  negative log probability, averaged over the minibatch, + l1/l2;
+- z = mu + sigma * eps reparameterization, decoder MLP -> distribution
+  preactivations, ``ReconstructionDistribution.negLogProbability``.
+
+The whole pretrain loss is one differentiable function: ``jax.grad``
+replaces the reference's 250-line hand-written backprop, and the MC loop
+(numSamples, default 1) unrolls into the same XLA program.
+
+The supervised-phase ``forward`` returns ``pzxActivationFn(mean preout)``
+exactly like the reference's ``activate`` (``VariationalAutoencoder.java:
+425-431``): a VAE inside a backprop net contributes its posterior mean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import activations as _activations
+from .. import lossfunctions as _losses
+from ..conf import serde
+from ..weights import init_weights
+from .base import Array, FeedForwardLayerConfig, ParamTree, StateTree
+
+_NEG_HALF_LOG_2PI = -0.5 * float(np.log(2.0 * np.pi))
+
+
+# --------------------------------------------------------------------------
+# Reconstruction distributions (reference nn/conf/layers/variational/*)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReconstructionDistribution:
+    """p(x|z) parameterized by decoder preactivations."""
+
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        """Sum over batch+features of -log p(x|preout)."""
+        raise NotImplementedError
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        raise NotImplementedError
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        raise NotImplementedError
+
+
+@serde.register("gaussian_reconstruction")
+@dataclasses.dataclass
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """Reference ``GaussianReconstructionDistribution.java``: preout is
+    ``[mean | log sigma^2]`` (2x data size), activation applied to both."""
+
+    def input_size(self, data_size: int) -> int:
+        return 2 * data_size
+
+    def _params(self, preout: Array) -> Tuple[Array, Array]:
+        out = _activations.get(self.activation)(preout)
+        size = preout.shape[-1] // 2
+        return out[..., :size], out[..., size:]
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        mean, log_sigma2 = self._params(preout)
+        sigma2 = jnp.exp(log_sigma2)
+        log_prob = (x.shape[0] * (preout.shape[-1] // 2) * _NEG_HALF_LOG_2PI
+                    - 0.5 * jnp.sum(log_sigma2)
+                    - jnp.sum((x - mean) ** 2 / (2.0 * sigma2)))
+        return -log_prob
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        return self._params(preout)[0]
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        mean, log_sigma2 = self._params(preout)
+        return mean + jnp.exp(0.5 * log_sigma2) * jax.random.normal(
+            rng, mean.shape, mean.dtype)
+
+
+@serde.register("bernoulli_reconstruction")
+@dataclasses.dataclass
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """Reference ``BernoulliReconstructionDistribution.java`` (sigmoid)."""
+
+    activation: str = "sigmoid"
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        if self.activation == "sigmoid":
+            # Numerically stable fused sigmoid + BCE on the preactivation.
+            return jnp.sum(jax.nn.softplus(preout) - x * preout)
+        p = jnp.clip(_activations.get(self.activation)(preout), 1e-10,
+                     1 - 1e-10)
+        return -jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log1p(-p))
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        return _activations.get(self.activation)(preout)
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        p = _activations.get(self.activation)(preout)
+        return jax.random.bernoulli(rng, p).astype(preout.dtype)
+
+
+@serde.register("exponential_reconstruction")
+@dataclasses.dataclass
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """Reference ``ExponentialReconstructionDistribution.java``: network
+    models gamma = log(lambda); log p(x) = gamma - lambda * x."""
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        gamma = _activations.get(self.activation)(preout)
+        lam = jnp.exp(gamma)
+        return -jnp.sum(gamma - lam * x)
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        gamma = _activations.get(self.activation)(preout)
+        return jnp.exp(-gamma)  # mean = 1/lambda
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        gamma = _activations.get(self.activation)(preout)
+        u = jax.random.uniform(rng, gamma.shape, gamma.dtype, 1e-10, 1.0)
+        return -jnp.log(u) * jnp.exp(-gamma)
+
+
+@serde.register("loss_wrapper_reconstruction")
+@dataclasses.dataclass
+class LossFunctionWrapper(ReconstructionDistribution):
+    """Reference ``LossFunctionWrapper.java``: treat an ILossFunction as an
+    (improper) reconstruction "distribution" — no probabilistic
+    interpretation, just loss-per-example summed."""
+
+    loss: str = "mse"
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        return _losses.score(self.loss, x, preout, self.activation, None,
+                             False)
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        return _activations.get(self.activation)(preout)
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        return self.generate_at_mean(preout)
+
+
+@serde.register("composite_reconstruction")
+@dataclasses.dataclass
+class CompositeReconstructionDistribution(ReconstructionDistribution):
+    """Reference ``CompositeReconstructionDistribution.java``: different
+    distributions over slices of the data vector.  ``parts`` is a list of
+    ``(data_size, distribution)`` pairs."""
+
+    parts: Sequence[Tuple[int, ReconstructionDistribution]] = ()
+
+    def __post_init__(self):
+        # JSON round-trip support: parts arrive as [[size, {"type": ...}]].
+        decoded = []
+        for size, dist in self.parts:
+            if isinstance(dist, dict):
+                dist = serde.from_dict(dist)
+            decoded.append((int(size), dist))
+        self.parts = tuple(decoded)
+
+    def input_size(self, data_size: int) -> int:
+        total = sum(size for size, _ in self.parts)
+        if total != data_size:
+            raise ValueError(
+                f"Composite parts cover {total} features, data has "
+                f"{data_size}")
+        return sum(dist.input_size(size) for size, dist in self.parts)
+
+    def _slices(self):
+        x_off = p_off = 0
+        for size, dist in self.parts:
+            p_size = dist.input_size(size)
+            yield (slice(x_off, x_off + size),
+                   slice(p_off, p_off + p_size), dist)
+            x_off += size
+            p_off += p_size
+
+    def neg_log_prob(self, x: Array, preout: Array) -> Array:
+        total = jnp.asarray(0.0, preout.dtype)
+        for xs, ps, dist in self._slices():
+            total = total + dist.neg_log_prob(x[..., xs], preout[..., ps])
+        return total
+
+    def generate_at_mean(self, preout: Array) -> Array:
+        return jnp.concatenate(
+            [dist.generate_at_mean(preout[..., ps])
+             for _, ps, dist in self._slices()], axis=-1)
+
+    def sample(self, rng: jax.Array, preout: Array) -> Array:
+        keys = jax.random.split(rng, max(1, len(self.parts)))
+        return jnp.concatenate(
+            [dist.sample(keys[i], preout[..., ps])
+             for i, (_, ps, dist) in enumerate(self._slices())], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# The layer
+# --------------------------------------------------------------------------
+
+
+@serde.register("variational_autoencoder")
+@dataclasses.dataclass
+class VariationalAutoencoder(FeedForwardLayerConfig):
+    """Reference ``nn/conf/layers/variational/VariationalAutoencoder.java``
+    (builder: encoderLayerSizes/decoderLayerSizes/reconstructionDistribution/
+    pzxActivationFunction/numSamples) + the 1063-LoC impl.
+
+    ``n_out`` is the latent size.  Param keys mirror
+    ``VariationalAutoencoderParamInitializer``: ``e{i}W/e{i}b`` encoder,
+    ``pZXMeanW/pZXMeanb/pZXLogStd2W/pZXLogStd2b`` posterior heads,
+    ``d{i}W/d{i}b`` decoder, ``pXZW/pXZb`` reconstruction head.
+    """
+
+    IS_PRETRAINABLE = True
+
+    encoder_layer_sizes: Sequence[int] = (100,)
+    decoder_layer_sizes: Sequence[int] = (100,)
+    pzx_activation: str = "identity"
+    reconstruction_distribution: ReconstructionDistribution = \
+        dataclasses.field(default_factory=GaussianReconstructionDistribution)
+    num_samples: int = 1
+
+    def param_order(self) -> tuple[str, ...]:
+        order: List[str] = []
+        for i in range(len(self.encoder_layer_sizes)):
+            order += [f"e{i}W", f"e{i}b"]
+        order += ["pZXMeanW", "pZXMeanb", "pZXLogStd2W", "pZXLogStd2b"]
+        for i in range(len(self.decoder_layer_sizes)):
+            order += [f"d{i}W", f"d{i}b"]
+        order += ["pXZW", "pXZb"]
+        return tuple(order)
+
+    def l1_by_param(self):
+        return {k: ((self.l1_bias if k.endswith("b") else self.l1) or 0.0)
+                for k in self.param_order()}
+
+    def l2_by_param(self):
+        return {k: ((self.l2_bias if k.endswith("b") else self.l2) or 0.0)
+                for k in self.param_order()}
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        params: ParamTree = {}
+        wi = self.weight_init or "xavier"
+        bias = self.bias_init or 0.0
+        sizes = list(self.encoder_layer_sizes)
+        keys = jax.random.split(rng, len(sizes)
+                                + len(self.decoder_layer_sizes) + 3)
+        k = 0
+        n_prev = self.n_in
+        for i, h in enumerate(sizes):
+            params[f"e{i}W"] = init_weights(keys[k], (n_prev, h), wi,
+                                            self.dist, dtype)
+            params[f"e{i}b"] = jnp.full((h,), bias, dtype)
+            n_prev = h
+            k += 1
+        params["pZXMeanW"] = init_weights(keys[k], (n_prev, self.n_out), wi,
+                                          self.dist, dtype)
+        params["pZXMeanb"] = jnp.full((self.n_out,), bias, dtype)
+        k += 1
+        params["pZXLogStd2W"] = init_weights(keys[k], (n_prev, self.n_out),
+                                             wi, self.dist, dtype)
+        params["pZXLogStd2b"] = jnp.full((self.n_out,), bias, dtype)
+        k += 1
+        n_prev = self.n_out
+        for i, h in enumerate(self.decoder_layer_sizes):
+            params[f"d{i}W"] = init_weights(keys[k], (n_prev, h), wi,
+                                            self.dist, dtype)
+            params[f"d{i}b"] = jnp.full((h,), bias, dtype)
+            n_prev = h
+            k += 1
+        out_size = self.reconstruction_distribution.input_size(self.n_in)
+        params["pXZW"] = init_weights(keys[k], (n_prev, out_size), wi,
+                                      self.dist, dtype)
+        params["pXZb"] = jnp.full((out_size,), bias, dtype)
+        return params
+
+    # ------------------------------------------------------------- pieces
+    def _encode(self, params: ParamTree, x: Array) -> Array:
+        afn = _activations.get(self.activation or "tanh")
+        for i in range(len(self.encoder_layer_sizes)):
+            x = afn(x @ params[f"e{i}W"] + params[f"e{i}b"])
+        return x
+
+    def _posterior(self, params: ParamTree, x: Array) -> Tuple[Array, Array]:
+        enc = self._encode(params, x)
+        pzx_fn = _activations.get(self.pzx_activation)
+        mean = pzx_fn(enc @ params["pZXMeanW"] + params["pZXMeanb"])
+        log_sigma2 = pzx_fn(enc @ params["pZXLogStd2W"]
+                            + params["pZXLogStd2b"])
+        return mean, log_sigma2
+
+    def _decode(self, params: ParamTree, z: Array) -> Array:
+        afn = _activations.get(self.activation or "tanh")
+        x = z
+        for i in range(len(self.decoder_layer_sizes)):
+            x = afn(x @ params[f"d{i}W"] + params[f"d{i}b"])
+        return x @ params["pXZW"] + params["pXZb"]
+
+    # ---------------------------------------------------------- supervised
+    def forward(self, params: ParamTree, state: StateTree, x: Array, *,
+                train: bool, rng=None, mask=None) -> Tuple[Array, StateTree]:
+        x = self.apply_dropout(x, train, rng)
+        enc = self._encode(params, x)
+        pzx_fn = _activations.get(self.pzx_activation)
+        return pzx_fn(enc @ params["pZXMeanW"] + params["pZXMeanb"]), state
+
+    # --------------------------------------------------------- unsupervised
+    def pretrain_loss(self, params: ParamTree, x: Array,
+                      rng: Optional[jax.Array]) -> Array:
+        if rng is None:
+            raise ValueError("VAE pretraining needs an rng key")
+        batch = x.shape[0]
+        mean, log_sigma2 = self._posterior(params, x)
+        sigma2 = jnp.exp(log_sigma2)
+        # KL[q(z|x) || N(0,I)], averaged over the minibatch (reference
+        # scorePt1 at VariationalAutoencoder.java:160-166).
+        kl = (-0.5 / batch) * jnp.sum(1.0 + log_sigma2 - mean * mean
+                                      - sigma2)
+        sigma = jnp.sqrt(sigma2)
+        nll = jnp.asarray(0.0, x.dtype)
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape,
+                                    mean.dtype)
+            z = mean + sigma * eps
+            preout = self._decode(params, z)
+            nll = nll + self.reconstruction_distribution.neg_log_prob(
+                x, preout)
+        return kl + nll / (self.num_samples * batch)
+
+    def pretrain_grads(self, params: ParamTree, x: Array,
+                       rng: Optional[jax.Array]):
+        return jax.value_and_grad(self.pretrain_loss)(params, x, rng)
+
+    # ----------------------------------------------------------- public API
+    def reconstruction_log_probability(self, params: ParamTree, x: Array,
+                                       num_samples: int,
+                                       rng: jax.Array) -> Array:
+        """Per-example log P(x) IS estimate (reference
+        ``reconstructionLogProbability:869-905``): log mean_s p(x|z_s) with
+        z_s ~ q(z|x)."""
+        mean, log_sigma2 = self._posterior(params, x)
+        sigma = jnp.exp(0.5 * log_sigma2)
+
+        per_sample = []
+        for s in range(num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape,
+                                    mean.dtype)
+            z = mean + sigma * eps
+            preout = self._decode(params, z)
+            # per-example log prob: re-express the summed NLL per example
+            per = -jax.vmap(
+                lambda xe, pe: self.reconstruction_distribution.neg_log_prob(
+                    xe[None], pe[None]))(x, preout)
+            per_sample.append(per)
+        stacked = jnp.stack(per_sample)           # (S, batch)
+        return jax.nn.logsumexp(stacked, axis=0) - jnp.log(
+            float(num_samples))
+
+    def generate_at_mean_given_z(self, params: ParamTree, z: Array) -> Array:
+        return self.reconstruction_distribution.generate_at_mean(
+            self._decode(params, z))
+
+    def generate_random_given_z(self, params: ParamTree, z: Array,
+                                rng: jax.Array) -> Array:
+        return self.reconstruction_distribution.sample(
+            rng, self._decode(params, z))

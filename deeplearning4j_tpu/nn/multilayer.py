@@ -47,6 +47,8 @@ class MultiLayerNetwork:
         self._rng_key: Optional[jax.Array] = None
         self._rnn_carries = None
         self._rnn_carry_batch = -1
+        self._pretrain_step_cache: Dict[int, Any] = {}
+        self._pretrain_done = False
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -133,10 +135,27 @@ class MultiLayerNetwork:
         updater-side to match the reference order of operations (SURVEY.md §7
         hard part d); the reported score adds the reg term separately
         (``BaseLayer.calcL2``)."""
+        out_layer = self.layers[-1]
+        if getattr(out_layer, "NEEDS_INPUT_FOR_SCORE", False):
+            # Center-loss-style heads score against the layer *input* (the
+            # penultimate features) as well as the preactivation.
+            n = len(self.layers)
+            x, new_state, new_carries = self._forward(
+                params, net_state, features, train=train, rng=rng,
+                mask=features_mask, carries=carries, to_layer=n - 2)
+            if (n - 1) in self.conf.input_preprocessors:
+                x = self.conf.input_preprocessors[n - 1](x)
+            if out_layer.dropout and train:
+                x = out_layer.apply_dropout(
+                    x, train, jax.random.fold_in(rng, n - 1)
+                    if rng is not None else None)
+            data_loss = out_layer.compute_score_with_input(
+                params[n - 1], labels, x, labels_mask,
+                average=self.conf.conf.mini_batch)
+            return data_loss, (new_state, new_carries)
         preout, new_state, new_carries = self._forward(
             params, net_state, features, train=train, rng=rng,
             mask=features_mask, carries=carries, preoutput_last=True)
-        out_layer = self.layers[-1]
         if not hasattr(out_layer, "compute_score"):
             raise ValueError(
                 "Last layer must be an output/loss layer to fit()")
@@ -258,6 +277,83 @@ class MultiLayerNetwork:
             return out, new_carries
         return jax.jit(run)
 
+    # -------------------------------------------------------------- pretrain
+    def _pretrain_step(self, i: int):
+        """Jitted one-batch unsupervised step for layer ``i``: forward the
+        input through layers 0..i-1 (inference mode), stop the gradient, and
+        apply the layer's ``pretrain_grads`` through the DL4J-order updater
+        — all one XLA program (reference ``MultiLayerNetwork.pretrain:991``:
+        per-layer fit with ``feedForwardToLayer`` input)."""
+        if i not in self._pretrain_step_cache:
+            layer = self.layers[i]
+            uconf = self._updater_conf(i)
+
+            def step(params, ustate_i, net_state, iteration, features,
+                     base_rng):
+                rng = jax.random.fold_in(base_rng, iteration)
+                x, _, _ = self._forward(params, net_state, features,
+                                        train=False, rng=None,
+                                        to_layer=i - 1)
+                if i in self.conf.input_preprocessors:
+                    x = self.conf.input_preprocessors[i](x)
+                x = jax.lax.stop_gradient(x)
+                score, grads = layer.pretrain_grads(params[i], x, rng)
+                grads = _updaters.regularize(grads, params[i],
+                                             layer.l1_by_param(),
+                                             layer.l2_by_param())
+                grads = _updaters.normalize_gradients(
+                    grads, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold)
+                updates, new_ustate = _updaters.compute_update(
+                    uconf, grads, ustate_i, iteration)
+                new_p = jax.tree.map(lambda p, u: p - u, params[i], updates)
+                score = score + _updaters.regularization_score(
+                    params[i], layer.l1_by_param(), layer.l2_by_param())
+                return new_p, new_ustate, score
+
+            self._pretrain_step_cache[i] = jax.jit(step, donate_argnums=(1,))
+        return self._pretrain_step_cache[i]
+
+    def pretrain(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+        """Greedy layer-wise unsupervised pretraining of every pretrainable
+        layer (VAE/AutoEncoder/RBM), in order (reference
+        ``MultiLayerNetwork.pretrain:991``)."""
+        self.init()
+        if not isinstance(data, DataSet) and not hasattr(data, "reset"):
+            data = list(data)  # one-shot iterable: each layer needs a pass
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "IS_PRETRAINABLE", False):
+                self.pretrain_layer(i, data, epochs)
+        return self
+
+    def pretrain_layer(self, i: int, data,
+                       epochs: int = 1) -> "MultiLayerNetwork":
+        """Pretrain one layer (reference ``pretrainLayer``); non-pretrainable
+        layers are skipped like the reference (no-op, not an error)."""
+        self.init()
+        layer = self.layers[i]
+        if not getattr(layer, "IS_PRETRAINABLE", False):
+            return self
+        step = self._pretrain_step(i)
+        if isinstance(data, DataSet):
+            data_iter: Sequence[DataSet] = [data]
+        else:
+            data_iter = data
+        for _ in range(epochs):
+            if hasattr(data_iter, "reset"):
+                data_iter.reset()
+            for ds in data_iter:
+                features = jnp.asarray(ds.features)
+                (self.params[i], self.updater_state[i],
+                 score) = step(self.params, self.updater_state[i],
+                               self.net_state, self.iteration, features,
+                               self._rng_key)
+                self._score = score
+                self.iteration += 1
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+        return self
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
         """Train (reference ``fit(DataSetIterator):976`` /
@@ -265,6 +361,11 @@ class MultiLayerNetwork:
 
         ``data`` may be a DataSetIterator-like iterable of :class:`DataSet`,
         a single :class:`DataSet`, or a features array with ``labels``.
+
+        With ``conf.pretrain=True`` the first call runs layer-wise
+        unsupervised pretraining before supervised backprop (reference
+        ``fit`` at ``:991``); with ``conf.backprop=False`` only pretraining
+        runs.
         """
         self.init()
         if labels is not None:
@@ -275,6 +376,17 @@ class MultiLayerNetwork:
         else:
             iterator = data
             batches = None
+
+        if self.conf.pretrain and not self._pretrain_done:
+            if batches is None and not hasattr(iterator, "reset"):
+                # One-shot iterable: materialize so layer-wise pretraining
+                # and the supervised phase each see the full data.
+                batches = list(iterator)
+                iterator = None
+            self.pretrain(batches if batches is not None else iterator)
+            self._pretrain_done = True
+        if not self.conf.backprop:
+            return self
 
         for _ in range(epochs):
             for listener in self.listeners:
@@ -567,4 +679,5 @@ class MultiLayerNetwork:
         other.net_state = jax.tree.map(jnp.copy, self.net_state)
         other.updater_state = jax.tree.map(jnp.copy, self.updater_state)
         other.iteration = self.iteration
+        other._pretrain_done = self._pretrain_done
         return other

@@ -1,0 +1,395 @@
+"""Pretraining-family tests: VAE / AutoEncoder / RBM / CenterLoss + the
+layer-wise pretrain path — the analogue of the reference's
+``VaeGradientCheckTests``, ``nn/layers/feedforward`` AE/RBM tests and
+``CenterLossOutputLayerTest``."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.gradientcheck import (check_gradients,
+                                              check_pretrain_gradients)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.pretrain import AutoEncoder, RBM
+from deeplearning4j_tpu.nn.layers.training import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.layers.variational import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution, LossFunctionWrapper,
+    VariationalAutoencoder)
+
+
+def _builder(seed=12345, **kw):
+    b = (NeuralNetConfiguration.builder().seed(seed).dtype("float64")
+         .updater("sgd").learning_rate(0.1).weight_init("xavier"))
+    for k, v in kw.items():
+        getattr(b, k)(v)
+    return b
+
+
+def _data(b=6, n=4, seed=0, positive=False):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(b, n) if positive else rng.randn(b, n)
+    y = np.eye(3)[rng.randint(0, 3, b)]
+    return DataSet(x, y)
+
+
+# ---------------------------------------------------------------- VAE
+
+@pytest.mark.parametrize("dist", [
+    GaussianReconstructionDistribution(activation="identity"),
+    GaussianReconstructionDistribution(activation="tanh"),
+    BernoulliReconstructionDistribution(),
+    ExponentialReconstructionDistribution(),
+    LossFunctionWrapper(activation="tanh", loss="mse"),
+])
+def test_vae_pretrain_gradients(dist):
+    """Reference ``VaeGradientCheckTests.testVaePretrain``: analytic vs
+    numerical gradients of the variational loss for each reconstruction
+    distribution."""
+    conf = (_builder(activation="tanh").list()
+            .layer(VariationalAutoencoder(
+                n_in=4, n_out=3, encoder_layer_sizes=(5,),
+                decoder_layer_sizes=(5,), reconstruction_distribution=dist))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = _data(positive=isinstance(
+        dist, (BernoulliReconstructionDistribution,
+               ExponentialReconstructionDistribution)))
+    assert check_pretrain_gradients(net, ds, 0, print_results=True)
+
+
+def test_vae_composite_distribution_gradients():
+    dist = CompositeReconstructionDistribution(parts=(
+        (2, GaussianReconstructionDistribution(activation="identity")),
+        (2, BernoulliReconstructionDistribution()),
+    ))
+    conf = (_builder(activation="tanh").list()
+            .layer(VariationalAutoencoder(
+                n_in=4, n_out=3, encoder_layer_sizes=(5,),
+                decoder_layer_sizes=(5,),
+                reconstruction_distribution=dist))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_pretrain_gradients(net, _data(positive=True), 0,
+                                    print_results=True)
+
+
+def test_vae_multiple_samples_and_depth():
+    conf = (_builder(activation="tanh").list()
+            .layer(VariationalAutoencoder(
+                n_in=4, n_out=2, encoder_layer_sizes=(6, 5),
+                decoder_layer_sizes=(5, 6), num_samples=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_pretrain_gradients(net, _data(), 0, print_results=True)
+
+
+def test_vae_supervised_forward_and_backprop():
+    """A VAE inside a backprop net contributes its posterior mean and the
+    supervised gradients check out (reference VaeGradientCheckTests
+    testVaeAsMLP)."""
+    conf = (_builder(activation="tanh").list()
+            .layer(VariationalAutoencoder(
+                n_in=4, n_out=3, encoder_layer_sizes=(5,),
+                decoder_layer_sizes=(5,)))
+            .layer(OutputLayer(n_in=3, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = _data()
+    out = net.output(ds.features)
+    assert out.shape == (6, 3)
+    assert check_gradients(net, ds)
+
+
+def test_vae_pretrain_learns_reconstruction():
+    """Pretraining reduces reconstruction NLL on structured data."""
+    rng = np.random.RandomState(3)
+    base = rng.randn(2, 8)
+    x = np.repeat(base, 32, axis=0) + 0.1 * rng.randn(64, 8)
+    conf = (_builder(activation="tanh", updater="adam", learning_rate=0.01)
+            .list()
+            .layer(VariationalAutoencoder(
+                n_in=8, n_out=2, encoder_layer_sizes=(16,),
+                decoder_layer_sizes=(16,)))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    layer = net.layers[0]
+    key = jax.random.PRNGKey(0)
+    loss0 = float(layer.pretrain_loss(net.params[0], x, key))
+    ds = DataSet(x, np.zeros((64, 1)))
+    net.pretrain_layer(0, ds, epochs=60)
+    loss1 = float(layer.pretrain_loss(net.params[0], x, key))
+    assert loss1 < loss0 - 1.0
+
+    # reconstruction/generation API surface
+    logp = layer.reconstruction_log_probability(net.params[0], x[:4], 5,
+                                                jax.random.PRNGKey(1))
+    assert logp.shape == (4,)
+    z = np.zeros((3, 2))
+    recon = layer.generate_at_mean_given_z(net.params[0], z)
+    assert recon.shape == (3, 8)
+
+
+# ---------------------------------------------------------------- AE
+
+def test_autoencoder_pretrain_gradients():
+    conf = (_builder(activation="sigmoid").list()
+            .layer(AutoEncoder(n_in=4, n_out=3, corruption_level=0.0))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_pretrain_gradients(net, _data(positive=True), 0,
+                                    print_results=True)
+
+
+def test_autoencoder_sparsity_gradients():
+    conf = (_builder(activation="sigmoid").list()
+            .layer(AutoEncoder(n_in=4, n_out=3, corruption_level=0.0,
+                               sparsity=0.1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_pretrain_gradients(net, _data(positive=True), 0,
+                                    print_results=True)
+
+
+def test_autoencoder_denoising_reconstruction_improves():
+    rng = np.random.RandomState(0)
+    x = (rng.rand(128, 16) > 0.5).astype(np.float64)
+    conf = (_builder(activation="sigmoid", updater="adam",
+                     learning_rate=0.01).list()
+            .layer(AutoEncoder(n_in=16, n_out=8, corruption_level=0.3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    layer = net.layers[0]
+    err0 = float(np.mean(
+        (np.asarray(layer.reconstruct(net.params[0], x)) - x) ** 2))
+    net.pretrain(DataSet(x, np.zeros((128, 1))), epochs=80)
+    err1 = float(np.mean(
+        (np.asarray(layer.reconstruct(net.params[0], x)) - x) ** 2))
+    assert err1 < err0 * 0.7
+
+
+# ---------------------------------------------------------------- RBM
+
+def test_rbm_cd_reduces_reconstruction_error():
+    rng = np.random.RandomState(1)
+    protos = (rng.rand(4, 12) > 0.5).astype(np.float64)
+    x = np.repeat(protos, 16, axis=0)
+    flip = rng.rand(*x.shape) < 0.05
+    x = np.where(flip, 1 - x, x)
+    conf = (_builder(updater="sgd", learning_rate=0.1).list()
+            .layer(RBM(n_in=12, n_out=8, k=1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    layer = net.layers[0]
+
+    def recon_err(params):
+        h = layer.prop_up(params, x)
+        v = layer.prop_down(params, h)
+        return float(np.mean((np.asarray(v) - x) ** 2))
+
+    err0 = recon_err(net.params[0])
+    net.pretrain(DataSet(x, np.zeros((64, 1))), epochs=40)
+    err1 = recon_err(net.params[0])
+    assert err1 < err0 * 0.8
+
+
+def test_rbm_free_energy_favors_data_over_noise():
+    """After CD training the model assigns lower free energy (higher
+    likelihood) to training-like patterns than to random noise."""
+    rng = np.random.RandomState(2)
+    protos = (rng.rand(2, 10) > 0.5).astype(np.float64)
+    x = np.repeat(protos, 32, axis=0)
+    conf = (_builder(updater="sgd", learning_rate=0.1).list()
+            .layer(RBM(n_in=10, n_out=6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    layer = net.layers[0]
+    net.pretrain(DataSet(x, np.zeros((64, 1))), epochs=40)
+    noise = (rng.rand(64, 10) > 0.5).astype(np.float64)
+    f_data = float(layer.free_energy(net.params[0], x))
+    f_noise = float(layer.free_energy(net.params[0], noise))
+    assert f_data < f_noise
+
+
+def test_rbm_gaussian_visible():
+    rng = np.random.RandomState(4)
+    x = rng.randn(32, 6)
+    conf = (_builder(updater="sgd", learning_rate=0.01).list()
+            .layer(RBM(n_in=6, n_out=4, visible_unit="gaussian"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain(DataSet(x, np.zeros((32, 1))), epochs=5)
+    assert np.all(np.isfinite(net.get_flat_params()))
+
+
+# ------------------------------------------------- pretrain path wiring
+
+def test_pretrain_then_backprop_stack():
+    """conf.pretrain=True: fit() runs layer-wise pretraining once, then
+    supervised backprop (reference MultiLayerNetwork.fit:991)."""
+    rng = np.random.RandomState(5)
+    x = rng.rand(64, 8)
+    y = np.eye(2)[(x.sum(1) > 4).astype(int)]
+    conf = (_builder(activation="sigmoid", updater="adam",
+                     learning_rate=0.01)
+            .list()
+            .layer(AutoEncoder(n_in=8, n_out=6, corruption_level=0.0))
+            .layer(OutputLayer(n_in=6, n_out=2))
+            .pretrain(True)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p_before = net.get_flat_params().copy()
+    net.fit(DataSet(x, y), epochs=150)
+    assert not np.allclose(net.get_flat_params(), p_before)
+    acc = (net.predict(x) == y.argmax(1)).mean()
+    assert acc > 0.85
+
+
+def test_pretrain_only_updates_target_layer():
+    conf = (_builder(activation="sigmoid").list()
+            .layer(AutoEncoder(n_in=4, n_out=3, corruption_level=0.0))
+            .layer(OutputLayer(n_in=3, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out_params_before = np.asarray(net.params[1]["W"]).copy()
+    ae_before = np.asarray(net.params[0]["W"]).copy()
+    net.pretrain(_data(positive=True))
+    assert not np.allclose(np.asarray(net.params[0]["W"]), ae_before)
+    np.testing.assert_array_equal(np.asarray(net.params[1]["W"]),
+                                  out_params_before)
+
+
+def test_graph_pretrain():
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    conf = (_builder(activation="sigmoid", seed=7).graph_builder()
+            .add_inputs("in")
+            .add_layer("ae", AutoEncoder(n_in=4, n_out=3,
+                                         corruption_level=0.0), "in")
+            .add_layer("out", OutputLayer(n_in=3, n_out=3), "ae")
+            .set_outputs("out").build())
+    cg = ComputationGraph(conf).init()
+    before = np.asarray(cg.params["ae"]["W"]).copy()
+    cg.pretrain(_data(positive=True))
+    assert not np.allclose(np.asarray(cg.params["ae"]["W"]), before)
+
+
+# ------------------------------------------------- CenterLossOutputLayer
+
+def test_center_loss_gradients():
+    """gradient_check=True uses exact full-flow gradients (reference
+    ``CenterLossOutputLayer`` gradientCheck flag +
+    ``GradientCheckTests``)."""
+    conf = (_builder(activation="tanh").list()
+            .layer(DenseLayer(n_in=4, n_out=5))
+            .layer(CenterLossOutputLayer(n_in=5, n_out=3, lambda_=0.1,
+                                         gradient_check=True))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # move centers off zero so gradients are non-trivial
+    flat = net.get_flat_params()
+    net.set_flat_params(flat + 0.01 * np.random.RandomState(0).randn(
+        flat.size))
+    assert check_gradients(net, _data())
+
+
+def test_center_loss_centers_move_toward_class_means():
+    rng = np.random.RandomState(6)
+    x = np.concatenate([rng.randn(32, 4) + 3, rng.randn(32, 4) - 3])
+    y = np.eye(2)[np.array([0] * 32 + [1] * 32)]
+    conf = (_builder(activation="identity", updater="sgd",
+                     learning_rate=0.05).list()
+            .layer(CenterLossOutputLayer(n_in=4, n_out=2, alpha=0.5,
+                                         lambda_=0.01))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y), epochs=60)
+    centers = np.asarray(net.params[0]["cL"])
+    # class 0 mean ≈ +3, class 1 mean ≈ -3 per dim
+    assert centers[0].mean() > 1.0
+    assert centers[1].mean() < -1.0
+
+
+def test_center_loss_affects_training_loss():
+    ds = _data()
+    conf_plain = (_builder(activation="tanh").list()
+                  .layer(CenterLossOutputLayer(n_in=4, n_out=3,
+                                               lambda_=0.0)).build())
+    conf_center = (_builder(activation="tanh").list()
+                   .layer(CenterLossOutputLayer(n_in=4, n_out=3,
+                                                lambda_=1.0)).build())
+    n1 = MultiLayerNetwork(conf_plain).init()
+    n2 = MultiLayerNetwork(conf_center).init()
+    s1 = n1.score(ds)
+    s2 = n2.score(ds)
+    # centers start at 0: center term = lambda/2*||x||^2 > 0
+    assert s2 > s1
+
+
+# ------------------------------------------------- serde round-trips
+
+def test_pretrain_layer_serde_round_trip():
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration)
+
+    dist = CompositeReconstructionDistribution(parts=(
+        (2, GaussianReconstructionDistribution(activation="tanh")),
+        (2, BernoulliReconstructionDistribution()),
+    ))
+    conf = (_builder(activation="tanh").list()
+            .layer(VariationalAutoencoder(
+                n_in=4, n_out=3, encoder_layer_sizes=(5, 4),
+                decoder_layer_sizes=(4, 5),
+                reconstruction_distribution=dist, num_samples=2))
+            .layer(AutoEncoder(n_in=3, n_out=2, corruption_level=0.1,
+                               sparsity=0.05))
+            .layer(RBM(n_in=2, n_out=2, hidden_unit="binary",
+                       visible_unit="gaussian", k=3))
+            .layer(CenterLossOutputLayer(n_in=2, n_out=3, alpha=0.1,
+                                         lambda_=0.3))
+            .build())
+    restored = MultiLayerConfiguration.from_json(conf.to_json())
+    vae = restored.layers[0]
+    assert isinstance(vae, VariationalAutoencoder)
+    assert tuple(vae.encoder_layer_sizes) == (5, 4)
+    assert vae.num_samples == 2
+    rd = vae.reconstruction_distribution
+    assert isinstance(rd, CompositeReconstructionDistribution)
+    assert isinstance(rd.parts[0][1], GaussianReconstructionDistribution)
+    assert rd.parts[0][1].activation == "tanh"
+    assert isinstance(rd.parts[1][1], BernoulliReconstructionDistribution)
+    ae = restored.layers[1]
+    assert isinstance(ae, AutoEncoder) and ae.corruption_level == 0.1
+    rbm = restored.layers[2]
+    assert isinstance(rbm, RBM) and rbm.visible_unit == "gaussian"
+    assert rbm.k == 3
+    cl = restored.layers[3]
+    assert isinstance(cl, CenterLossOutputLayer) and cl.lambda_ == 0.3
+
+    # params init + one fit step works on the restored conf
+    net = MultiLayerNetwork(restored).init()
+    assert net.get_flat_params().size > 0
+
+
+def test_model_serializer_round_trip_with_pretrain_layers(tmp_path):
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_multi_layer_network, write_model)
+
+    conf = (_builder(activation="sigmoid").list()
+            .layer(AutoEncoder(n_in=4, n_out=3, corruption_level=0.0))
+            .layer(OutputLayer(n_in=3, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain(_data(positive=True))
+    path = str(tmp_path / "model.zip")
+    write_model(net, path)
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_allclose(restored.get_flat_params(),
+                               net.get_flat_params())
+    ds = _data(positive=True)
+    np.testing.assert_allclose(restored.output(ds.features),
+                               net.output(ds.features))
