@@ -1,0 +1,137 @@
+"""GloVe: co-occurrence-matrix embeddings.
+
+TPU-native equivalent of the reference's ``models/glove/Glove.java`` +
+``models/glove/AbstractCoOccurrences.java``: a host-side weighted
+co-occurrence scan (weight 1/distance within the window), then AdaGrad
+regression on ``f(X_ij) (w_i·w̃_j + b_i + b̃_j − log X_ij)²`` executed as
+jitted XLA batches (the reference runs per-pair AdaGrad in Java threads).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .vocab import VocabCache, VocabConstructor
+from .word2vec import SequenceVectors
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(W: Array, Wc: Array, b: Array, bc: Array, hW: Array,
+                hWc: Array, hb: Array, hbc: Array, rows: Array, cols: Array,
+                logx: Array, fx: Array, mask: Array, lr: Array):
+    """One AdaGrad batch over co-occurrence triples.
+
+    W/Wc: word and context embeddings; b/bc biases; h*: AdaGrad
+    accumulators.  Standard GloVe gradients with scatter-add updates.
+    """
+    wi = W[rows]
+    wj = Wc[cols]
+    diff = (jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - logx)
+    g = fx * diff * mask                               # (B,)
+    loss = 0.5 * jnp.sum(fx * diff * diff * mask)
+    gw = g[:, None] * wj
+    gwc = g[:, None] * wi
+    # AdaGrad: accumulate squared grads, scale updates
+    hW = hW.at[rows].add(gw * gw)
+    hWc = hWc.at[cols].add(gwc * gwc)
+    hb = hb.at[rows].add(g * g)
+    hbc = hbc.at[cols].add(g * g)
+    W = W.at[rows].add(-lr * gw / jnp.sqrt(hW[rows] + 1e-8))
+    Wc = Wc.at[cols].add(-lr * gwc / jnp.sqrt(hWc[cols] + 1e-8))
+    b = b.at[rows].add(-lr * g / jnp.sqrt(hb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * g / jnp.sqrt(hbc[cols] + 1e-8))
+    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
+
+
+class Glove(SequenceVectors):
+    """GloVe trainer (reference ``Glove.java`` builder: xMax, alpha,
+    learningRate, epochs, symmetric window)."""
+
+    def __init__(self, x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, **kwargs):
+        kwargs.setdefault("learning_rate", 0.05)
+        kwargs.setdefault("use_hierarchic_softmax", True)  # unused; appease
+        super().__init__(**kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self._context: Optional[Array] = None
+
+    # ------------------------------------------------------- co-occurrences
+    def _count_cooccurrences(self, seqs: List[List[str]]
+                             ) -> Dict[Tuple[int, int], float]:
+        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        for seq in seqs:
+            idx = self._sequence_to_indices(seq)
+            n = idx.size
+            for i in range(n):
+                for j in range(max(0, i - self.window_size), i):
+                    w = 1.0 / (i - j)
+                    counts[(int(idx[i]), int(idx[j]))] += w
+                    if self.symmetric:
+                        counts[(int(idx[j]), int(idx[i]))] += w
+        return counts
+
+    # ------------------------------------------------------------- training
+    def fit(self, sequences) -> "Glove":
+        seq_list = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seq_list)
+        counts = self._count_cooccurrences(seq_list)
+        if not counts:
+            return self
+        pairs = np.array(list(counts.keys()), np.int32)
+        xs = np.array(list(counts.values()), np.float32)
+        logx = np.log(xs)
+        fx = np.minimum(1.0, (xs / self.x_max) ** self.alpha).astype(
+            np.float32)
+
+        V, D = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        k1, k2 = jax.random.split(key)
+        W = ((jax.random.uniform(k1, (V, D), jnp.float32) - 0.5)
+             / D).astype(jnp.float32)
+        Wc = ((jax.random.uniform(k2, (V, D), jnp.float32) - 0.5)
+              / D).astype(jnp.float32)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        hW = jnp.zeros((V, D), jnp.float32)
+        hWc = jnp.zeros((V, D), jnp.float32)
+        hb = jnp.zeros((V,), jnp.float32)
+        hbc = jnp.zeros((V,), jnp.float32)
+        lr = jnp.float32(self.learning_rate)
+
+        B = self.batch_size
+        n = pairs.shape[0]
+        order = np.arange(n)
+        for _ in range(self.epochs):
+            self._rng.shuffle(order)
+            for s in range(0, n, B):
+                sel = order[s:s + B]
+                pad = B - sel.size
+                mask = np.concatenate([np.ones(sel.size, np.float32),
+                                       np.zeros(pad, np.float32)])
+                sel_p = np.concatenate([sel, np.zeros(pad, np.int64)])
+                (W, Wc, b, bc, hW, hWc, hb, hbc, _) = _glove_step(
+                    W, Wc, b, bc, hW, hWc, hb, hbc,
+                    jnp.asarray(pairs[sel_p, 0]),
+                    jnp.asarray(pairs[sel_p, 1]),
+                    jnp.asarray(logx[sel_p]), jnp.asarray(fx[sel_p]),
+                    jnp.asarray(mask), lr)
+
+        # Final embedding: W + Wc (standard GloVe practice; the reference
+        # exposes syn0)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, D, self.seed, use_hs=False, negative=1.0)
+        self.lookup_table.syn0 = W + Wc
+        self._context = Wc
+        return self

@@ -1,0 +1,85 @@
+"""In-memory embedding lookup table.
+
+TPU-native equivalent of the reference's
+``models/embeddings/inmemory/InMemoryLookupTable.java`` (734 LoC): the
+``syn0`` (input embeddings), ``syn1`` (hierarchical-softmax inner nodes) and
+``syn1Neg`` (negative-sampling output embeddings) matrices plus the unigram
+negative-sampling table.  The exp table is unnecessary — XLA computes real
+sigmoids on the device.
+
+Arrays are ``jax.Array``s living in device memory; the training kernels
+(``word2vec.py``) update them functionally with scatter-adds inside one
+jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    """syn0/syn1/syn1neg store + derived sampling tables."""
+
+    def __init__(self, vocab: VocabCache, vector_length: int = 100,
+                 seed: int = 42, use_hs: bool = True, negative: float = 0.0,
+                 dtype=jnp.float32):
+        self.vocab = vocab
+        self.vector_length = vector_length
+        self.seed = seed
+        self.use_hs = use_hs
+        self.negative = negative
+        self.dtype = dtype
+        self.syn0: Optional[jax.Array] = None
+        self.syn1: Optional[jax.Array] = None
+        self.syn1neg: Optional[jax.Array] = None
+        self._neg_table: Optional[np.ndarray] = None
+
+    def reset_weights(self) -> None:
+        """word2vec init: syn0 ~ U(-0.5, 0.5)/dim; syn1* zero (reference
+        ``InMemoryLookupTable.resetWeights``)."""
+        v = max(self.vocab.num_words(), 1)
+        d = self.vector_length
+        key = jax.random.PRNGKey(self.seed)
+        self.syn0 = ((jax.random.uniform(key, (v, d), jnp.float32) - 0.5)
+                     / d).astype(self.dtype)
+        if self.use_hs:
+            self.syn1 = jnp.zeros((max(v - 1, 1), d), self.dtype)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((v, d), self.dtype)
+
+    # ---------------------------------------------------- negative sampling
+    def negative_table(self, size: int = 1_000_000,
+                       power: float = 0.75) -> np.ndarray:
+        """Unigram^0.75 sampling table (reference ``makeTable``) — host-side
+        numpy; negatives are drawn on host per batch and shipped with it."""
+        if self._neg_table is None or self._neg_table.size != size:
+            words = self.vocab.vocab_words()
+            freqs = np.array([w.element_frequency for w in words],
+                             np.float64)
+            probs = freqs ** power
+            probs /= probs.sum()
+            counts = np.maximum(1, np.round(probs * size)).astype(np.int64)
+            self._neg_table = np.repeat(np.arange(len(words)), counts)
+        return self._neg_table
+
+    # ------------------------------------------------------------- lookups
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def set_vector(self, word: str, vec) -> None:
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            raise KeyError(word)
+        self.syn0 = self.syn0.at[idx].set(jnp.asarray(vec, self.syn0.dtype))
+
+    def weights(self) -> np.ndarray:
+        return np.asarray(self.syn0)

@@ -1,0 +1,193 @@
+"""ParagraphVectors (doc2vec).
+
+TPU-native equivalent of the reference's
+``models/paragraphvectors/ParagraphVectors.java`` with the sequence learning
+algorithms ``models/embeddings/learning/impl/sequence/DBOW.java`` and
+``DM.java``.
+
+Labels (document ids) are vocabulary elements with their own syn0 rows
+(reference: label elements added to the vocab with ``isLabel`` markers):
+
+- **DBOW** (distributed bag of words): the label vector is trained to
+  predict each word of its document — skip-gram with input = label.
+- **DM** (distributed memory): the label vector joins the context window
+  average predicting the center word — CBOW with the label appended to
+  every window.
+
+Both reuse the Word2Vec XLA kernels unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .sentence_iterator import (LabelAwareIterator, LabelledDocument,
+                                LabelsSource, SimpleLabelAwareIterator)
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, VocabWord, build_huffman_tree
+from .lookup_table import InMemoryLookupTable
+from .word2vec import SequenceVectors
+
+
+class ParagraphVectors(SequenceVectors):
+    """doc2vec trainer (reference ``ParagraphVectors.java``)."""
+
+    def __init__(self, sequence_learning_algorithm: str = "dbow",
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 train_word_vectors: bool = True, **kwargs):
+        kwargs.setdefault("min_word_frequency", 1)
+        super().__init__(**kwargs)
+        self.sequence_algorithm = sequence_learning_algorithm.lower()
+        if self.sequence_algorithm not in ("dbow", "dm"):
+            raise ValueError("sequence_learning_algorithm must be dbow|dm")
+        self.tokenizer_factory = tokenizer_factory \
+            or DefaultTokenizerFactory()
+        self.train_word_vectors = train_word_vectors
+        self.labels_source = LabelsSource()
+        self._docs: List[Tuple[List[str], str]] = []
+
+    # ------------------------------------------------------------ ingestion
+    def _resolve_documents(self, documents) -> List[Tuple[List[str], str]]:
+        if isinstance(documents, LabelAwareIterator):
+            docs = list(documents)
+        else:
+            docs = list(SimpleLabelAwareIterator(documents,
+                                                 self.labels_source))
+        out = []
+        for d in docs:
+            tokens = (self.tokenizer_factory.create(d.content).get_tokens()
+                      if isinstance(d.content, str) else list(d.content))
+            out.append((tokens, d.label))
+        return out
+
+    def build_vocab_from_documents(self, docs) -> None:
+        from .vocab import VocabConstructor
+        constructor = VocabConstructor(
+            min_word_frequency=self.min_word_frequency)
+        self.vocab = constructor.build_vocab([t for t, _ in docs])
+        # Label elements join the vocab with frequency 1 and is_label=True
+        # (excluded from subsampling and from being prediction targets).
+        for _, label in docs:
+            if not self.vocab.contains_word(label):
+                w = VocabWord(label, 1.0)
+                w.is_label = True
+                self.vocab.add_token(w)
+        self.vocab.finalize_vocab()
+        if self.use_hs:
+            build_huffman_tree(self.vocab,
+                               max_code_length=self.max_code_length)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, self.seed, self.use_hs,
+            self.negative)
+        self.lookup_table.reset_weights()
+        self._prepare_code_arrays()
+
+    # ------------------------------------------------------------- training
+    def fit(self, documents=None) -> "ParagraphVectors":
+        docs = self._resolve_documents(documents)
+        self._docs = docs
+        if self.vocab is None:
+            self.build_vocab_from_documents(docs)
+        total = sum(len(t) for t, _ in docs) * self.epochs * self.iterations
+        seen = 0
+        for _ in range(self.epochs):
+            for tokens, label in docs:
+                for _ in range(self.iterations):
+                    seen += len(tokens)
+                    alpha = max(self.min_learning_rate,
+                                self.learning_rate
+                                * (1.0 - seen / max(total + 1, 1)))
+                    self._train_document(tokens, label, alpha)
+        return self
+
+    def _train_document(self, tokens: Sequence[str], label: str,
+                        alpha: float) -> None:
+        word_idx = self._subsample_keep(self._sequence_to_indices(tokens))
+        label_idx = self.vocab.index_of(label)
+        if word_idx.size == 0 or label_idx < 0:
+            return
+        if self.train_word_vectors:
+            self._train_sequence(tokens, alpha)
+        if self.sequence_algorithm == "dbow":
+            # label -> each word (skip-gram, input = label row)
+            inputs = np.full(word_idx.size, label_idx, np.int64)
+            for s in range(0, word_idx.size, self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                self._skipgram_batch(inputs[sl], word_idx[sl], alpha)
+        else:
+            # DM: CBOW windows with the label appended to every context
+            ctx, cmask, centers = self._generate_cbow(word_idx)
+            if centers.size == 0:
+                # single-word docs: label alone predicts the word
+                ctx = np.zeros((word_idx.size, 1), np.int64)
+                cmask = np.zeros((word_idx.size, 1), np.float32)
+                centers = word_idx
+            label_col = np.full((ctx.shape[0], 1), label_idx, np.int64)
+            ctx = np.concatenate([ctx, label_col], axis=1)
+            cmask = np.concatenate(
+                [cmask, np.ones((cmask.shape[0], 1), np.float32)], axis=1)
+            for s in range(0, centers.size, self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                self._cbow_batch(ctx[sl], cmask[sl], centers[sl], alpha)
+
+    # ------------------------------------------------------------ inference
+    def infer_vector(self, text, steps: int = 20,
+                     alpha: float = 0.025) -> np.ndarray:
+        """Infer a vector for an unseen document (reference
+        ``inferVector``): gradient steps on a fresh row with all other
+        params frozen.  Host-side loop over a tiny problem — cheap."""
+        tokens = (self.tokenizer_factory.create(text).get_tokens()
+                  if isinstance(text, str) else list(text))
+        word_idx = self._sequence_to_indices(tokens)
+        rng = np.random.RandomState(abs(hash(tuple(tokens))) % (2 ** 31))
+        vec = ((rng.rand(self.layer_size) - 0.5)
+               / self.layer_size).astype(np.float32)
+        if word_idx.size == 0:
+            return vec
+        syn0 = self.lookup_table.weights()
+        if self.use_hs:
+            points, codes, cmask = [np.asarray(a)
+                                    for a in self._code_arrays]
+        for _ in range(steps):
+            if self.use_hs:
+                for w in word_idx:
+                    p, c, m = points[w], codes[w], cmask[w]
+                    w1 = np.asarray(self.lookup_table.syn1)[p]
+                    logits = w1 @ vec
+                    g = (1.0 - c - 1.0 / (1.0 + np.exp(-logits))) * m
+                    vec = vec + alpha * (g @ w1)
+            else:
+                table = self.lookup_table.negative_table()
+                syn1neg = np.asarray(self.lookup_table.syn1neg)
+                for w in word_idx:
+                    negs = table[rng.randint(0, table.size,
+                                             int(self.negative))]
+                    tgt = np.concatenate([[w], negs])
+                    lbl = np.concatenate([[1.0],
+                                          np.zeros(int(self.negative))])
+                    w1 = syn1neg[tgt]
+                    logits = w1 @ vec
+                    g = lbl - 1.0 / (1.0 + np.exp(-logits))
+                    vec = vec + alpha * (g @ w1)
+        return vec
+
+    def predict(self, text) -> Optional[str]:
+        """Nearest label for a document (reference ``predict``)."""
+        vec = self.infer_vector(text)
+        labels = [w for w in self.vocab.vocab_words() if w.is_label]
+        if not labels:
+            return None
+        m = self.lookup_table.weights()
+        best, best_sim = None, -np.inf
+        for w in labels:
+            lv = m[w.index]
+            denom = max(np.linalg.norm(lv) * np.linalg.norm(vec), 1e-12)
+            sim = float(lv @ vec / denom)
+            if sim > best_sim:
+                best, best_sim = w.word, sim
+        return best
+
+    def label_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.word_vector(label)

@@ -1,0 +1,489 @@
+"""SequenceVectors / Word2Vec: embedding training with XLA kernels.
+
+TPU-native equivalent of the reference's
+``models/sequencevectors/SequenceVectors.java:179`` (the fit pipeline:
+vocab build -> Huffman -> windowed training), ``models/word2vec/
+Word2Vec.java`` (598 LoC builder API) and the learning algorithms
+``models/embeddings/learning/impl/elements/SkipGram.java`` /
+``CBOW.java``.
+
+The hot loop: the reference dispatches every (center, context) pair to the
+native ND4J ``AggregateSkipGram`` C++ op (``SkipGram.java:258``).  The
+TPU-native redesign batches thousands of pairs and executes ONE jitted XLA
+step per batch: embedding gathers, a (B, L, D) dot-product block on the MXU,
+and scatter-adds back into syn0/syn1/syn1neg — duplicates accumulate
+correctly because XLA scatter-add is atomic per index.  Pair generation
+(window sampling, frequent-word subsampling, negative drawing from the
+unigram table) stays on host, exactly the role of the reference's per-thread
+Java loop that feeds the native op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, VocabConstructor, build_huffman_tree
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------------------
+# XLA kernels
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0: Array, syn1: Array, inputs: Array, points: Array,
+             codes: Array, code_mask: Array, pair_mask: Array,
+             lr: Array):
+    """Hierarchical-softmax batch update.
+
+    inputs (B,): syn0 rows (the context word in skip-gram; the averaged
+    window is handled by the CBOW kernel).  points/codes/code_mask (B, L):
+    the target word's Huffman path.  word2vec update: for each inner node,
+    g = (1 - code - sigmoid(h.w)) * lr; syn1 += g h; h += sum g w.
+    """
+    h = syn0[inputs]                                   # (B, D)
+    w = syn1[points]                                   # (B, L, D)
+    logits = jnp.einsum("bd,bld->bl", h, w)
+    mask = code_mask * pair_mask[:, None]
+    g = (1.0 - codes - jax.nn.sigmoid(logits)) * mask * lr
+    dh = jnp.einsum("bl,bld->bd", g, w)
+    syn1 = syn1.at[points].add(g[:, :, None] * h[:, None, :])
+    syn0 = syn0.at[inputs].add(dh)
+    # Monitored loss: BCE over the path, sign-folded logits.
+    loss = -jnp.sum(jax.nn.log_sigmoid((1.0 - 2.0 * codes) * logits) * mask)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ns_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
+             labels: Array, pair_mask: Array, lr: Array):
+    """Negative-sampling batch update (the ``AggregateSkipGram`` role).
+
+    targets (B, 1+K): positive word then K negatives; labels (1+K,) is
+    [1, 0, ..., 0].
+    """
+    h = syn0[inputs]                                   # (B, D)
+    w = syn1neg[targets]                               # (B, 1+K, D)
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels[None, :] - jax.nn.sigmoid(logits)) * pair_mask[:, None] * lr
+    dh = jnp.einsum("bk,bkd->bd", g, w)
+    syn1neg = syn1neg.at[targets].add(g[:, :, None] * h[:, None, :])
+    syn0 = syn0.at[inputs].add(dh)
+    loss = -jnp.sum(jax.nn.log_sigmoid(
+        jnp.where(labels[None, :] > 0, logits, -logits))
+        * pair_mask[:, None])
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0: Array, syn1: Array, contexts: Array,
+                  context_mask: Array, points: Array, codes: Array,
+                  code_mask: Array, pair_mask: Array, lr: Array):
+    """CBOW + HS: input is the mean of the window's vectors; the input-side
+    gradient is distributed to every context word (reference CBOW.java)."""
+    cvecs = syn0[contexts]                             # (B, C, D)
+    counts = jnp.maximum(jnp.sum(context_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.einsum("bcd,bc->bd", cvecs, context_mask) / counts
+    w = syn1[points]
+    logits = jnp.einsum("bd,bld->bl", h, w)
+    mask = code_mask * pair_mask[:, None]
+    g = (1.0 - codes - jax.nn.sigmoid(logits)) * mask * lr
+    dh = jnp.einsum("bl,bld->bd", g, w) / counts       # (B, D)
+    syn1 = syn1.at[points].add(g[:, :, None] * h[:, None, :])
+    syn0 = syn0.at[contexts].add(dh[:, None, :] * context_mask[:, :, None])
+    loss = -jnp.sum(jax.nn.log_sigmoid((1.0 - 2.0 * codes) * logits) * mask)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_ns_step(syn0: Array, syn1neg: Array, contexts: Array,
+                  context_mask: Array, targets: Array, labels: Array,
+                  pair_mask: Array, lr: Array):
+    cvecs = syn0[contexts]
+    counts = jnp.maximum(jnp.sum(context_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.einsum("bcd,bc->bd", cvecs, context_mask) / counts
+    w = syn1neg[targets]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels[None, :] - jax.nn.sigmoid(logits)) * pair_mask[:, None] * lr
+    dh = jnp.einsum("bk,bkd->bd", g, w) / counts
+    syn1neg = syn1neg.at[targets].add(g[:, :, None] * h[:, None, :])
+    syn0 = syn0.at[contexts].add(dh[:, None, :] * context_mask[:, :, None])
+    loss = -jnp.sum(jax.nn.log_sigmoid(
+        jnp.where(labels[None, :] > 0, logits, -logits))
+        * pair_mask[:, None])
+    return syn0, syn1neg, loss
+
+
+# --------------------------------------------------------------------------
+# SequenceVectors
+# --------------------------------------------------------------------------
+
+
+class SequenceVectors:
+    """Generic embedding trainer over sequences of tokens (reference
+    ``SequenceVectors.java``; Word2Vec/ParagraphVectors/DeepWalk build on
+    it).
+
+    Builder-style kwargs mirror the reference
+    ``SequenceVectors.Builder`` / ``Word2Vec.Builder``:
+    ``layer_size`` (layerSize), ``window_size`` (windowSize),
+    ``min_word_frequency``, ``iterations``, ``epochs``, ``learning_rate``
+    (0.025), ``min_learning_rate`` (1e-4), ``negative`` (negative samples; 0
+    = off), ``use_hierarchic_softmax``, ``sampling`` (frequent-word
+    subsampling threshold; 0 = off), ``batch_size`` (pairs per XLA step),
+    ``elements_learning_algorithm`` ("skipgram" | "cbow"), ``seed``.
+    """
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, iterations: int = 1,
+                 epochs: int = 1, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, negative: float = 0.0,
+                 use_hierarchic_softmax: bool = True, sampling: float = 0.0,
+                 batch_size: int = 2048, seed: int = 42,
+                 elements_learning_algorithm: str = "skipgram",
+                 max_code_length: int = 40):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.iterations = iterations
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.sampling = sampling
+        self.batch_size = batch_size
+        self.seed = seed
+        self.algorithm = elements_learning_algorithm.lower()
+        self.max_code_length = max_code_length
+        if not self.use_hs and self.negative <= 0:
+            raise ValueError(
+                "Enable hierarchical softmax and/or negative sampling")
+
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._rng = np.random.RandomState(seed)
+        self._code_arrays = None
+
+    # ----------------------------------------------------------- vocab prep
+    def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
+        constructor = VocabConstructor(
+            min_word_frequency=self.min_word_frequency)
+        self.vocab = constructor.build_vocab(sequences)
+        if self.use_hs:
+            build_huffman_tree(self.vocab,
+                               max_code_length=self.max_code_length)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, self.seed, self.use_hs,
+            self.negative)
+        self.lookup_table.reset_weights()
+        self._prepare_code_arrays()
+
+    def _prepare_code_arrays(self) -> None:
+        """Pack per-word Huffman codes/points into dense (V, L) arrays for
+        device gathers."""
+        if not self.use_hs:
+            self._code_arrays = None
+            return
+        words = self.vocab.vocab_words()
+        L = max((len(w.codes) for w in words), default=1)
+        L = max(L, 1)
+        V = len(words)
+        points = np.zeros((V, L), np.int32)
+        codes = np.zeros((V, L), np.float32)
+        mask = np.zeros((V, L), np.float32)
+        for w in words:
+            n = len(w.codes)
+            points[w.index, :n] = w.points
+            codes[w.index, :n] = w.codes
+            mask[w.index, :n] = 1.0
+        self._code_arrays = (jnp.asarray(points), jnp.asarray(codes),
+                             jnp.asarray(mask))
+
+    # ------------------------------------------------------- pair generation
+    def _subsample_keep(self, indices: np.ndarray) -> np.ndarray:
+        """Frequent-word subsampling filter (word2vec: keep prob
+        (sqrt(f/(sample*total)) + 1) * sample*total/f)."""
+        if self.sampling <= 0:
+            return indices
+        words = self.vocab.vocab_words()
+        freqs = np.array([words[i].element_frequency for i in indices])
+        total = self.vocab.total_word_count
+        ratio = self.sampling * total / np.maximum(freqs, 1.0)
+        keep_prob = np.minimum(1.0, np.sqrt(ratio) + ratio)
+        return indices[self._rng.rand(indices.size) < keep_prob]
+
+    def _sequence_to_indices(self, seq: Sequence[str]) -> np.ndarray:
+        idx = [self.vocab.index_of(t) for t in seq]
+        return np.array([i for i in idx if i >= 0], np.int64)
+
+    def _generate_pairs(self, indices: np.ndarray):
+        """(input_word, target_word) skip-gram pairs with per-center dynamic
+        window shrink b ~ U[0, window) (word2vec semantics: input = context
+        word, target = center word whose codes are trained)."""
+        n = indices.size
+        if n < 2:
+            return np.empty((0, 2), np.int64)
+        bs = self._rng.randint(0, self.window_size, n)
+        pairs = []
+        for pos in range(n):
+            center = indices[pos]
+            start = max(0, pos - self.window_size + bs[pos])
+            end = min(n, pos + self.window_size - bs[pos] + 1)
+            for j in range(start, end):
+                if j != pos:
+                    pairs.append((indices[j], center))
+        return np.array(pairs, np.int64)
+
+    def _generate_cbow(self, indices: np.ndarray):
+        """(context window, center) examples for CBOW."""
+        n = indices.size
+        C = 2 * self.window_size
+        if n < 2:
+            return (np.empty((0, C), np.int64), np.empty((0, C), np.float32),
+                    np.empty((0,), np.int64))
+        bs = self._rng.randint(0, self.window_size, n)
+        ctx = np.zeros((n, C), np.int64)
+        cmask = np.zeros((n, C), np.float32)
+        keep = []
+        for pos in range(n):
+            start = max(0, pos - self.window_size + bs[pos])
+            end = min(n, pos + self.window_size - bs[pos] + 1)
+            k = 0
+            for j in range(start, end):
+                if j != pos:
+                    ctx[pos, k] = indices[j]
+                    cmask[pos, k] = 1.0
+                    k += 1
+            if k:
+                keep.append(pos)
+        keep = np.array(keep, np.int64)
+        return ctx[keep], cmask[keep], indices[keep]
+
+    # ------------------------------------------------------------- training
+    def fit(self, sequences) -> "SequenceVectors":
+        """The reference fit pipeline (``SequenceVectors.java:179``):
+        build vocab -> Huffman -> train ``epochs`` passes."""
+        seq_list = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seq_list)
+        total_words = sum(len(s) for s in seq_list) * self.epochs \
+            * self.iterations
+        words_seen = 0
+        for _ in range(self.epochs):
+            for seq in seq_list:
+                for _ in range(self.iterations):
+                    words_seen += len(seq)
+                    alpha = max(
+                        self.min_learning_rate,
+                        self.learning_rate
+                        * (1.0 - words_seen / max(total_words + 1, 1)))
+                    self._train_sequence(seq, alpha)
+        return self
+
+    def _train_sequence(self, seq: Sequence[str], alpha: float) -> None:
+        indices = self._sequence_to_indices(seq)
+        indices = self._subsample_keep(indices)
+        if indices.size < 2:
+            return
+        lt = self.lookup_table
+        if self.algorithm == "cbow":
+            ctx, cmask, centers = self._generate_cbow(indices)
+            if centers.size == 0:
+                return
+            for s in range(0, centers.size, self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                self._cbow_batch(ctx[sl], cmask[sl], centers[sl], alpha)
+            return
+        pairs = self._generate_pairs(indices)
+        if pairs.size == 0:
+            return
+        for s in range(0, len(pairs), self.batch_size):
+            batch = pairs[s:s + self.batch_size]
+            self._skipgram_batch(batch[:, 0], batch[:, 1], alpha)
+
+    def _pad(self, arr: np.ndarray, size: int):
+        """Pad the leading axis to ``size`` (static XLA shapes) and return
+        (padded, pair_mask)."""
+        n = arr.shape[0]
+        mask = np.zeros(size, np.float32)
+        mask[:n] = 1.0
+        if n == size:
+            return arr, mask
+        pad = np.zeros((size - n,) + arr.shape[1:], arr.dtype)
+        return np.concatenate([arr, pad]), mask
+
+    def _skipgram_batch(self, inputs: np.ndarray, targets: np.ndarray,
+                        alpha: float) -> None:
+        lt = self.lookup_table
+        B = self.batch_size
+        inputs_p, pair_mask = self._pad(inputs.astype(np.int32), B)
+        targets_p, _ = self._pad(targets.astype(np.int32), B)
+        lr = jnp.float32(alpha)
+        if self.use_hs:
+            points, codes, cmask = self._code_arrays
+            lt.syn0, lt.syn1, _ = _hs_step(
+                lt.syn0, lt.syn1, jnp.asarray(inputs_p),
+                points[targets_p], codes[targets_p], cmask[targets_p],
+                jnp.asarray(pair_mask), lr)
+        if self.negative > 0:
+            table = lt.negative_table()
+            K = int(self.negative)
+            negs = table[self._rng.randint(0, table.size, (B, K))]
+            # negatives that collide with the positive are masked by
+            # resampling once (word2vec just skips them)
+            collide = negs == targets_p[:, None]
+            if collide.any():
+                negs[collide] = table[self._rng.randint(
+                    0, table.size, int(collide.sum()))]
+            tgt = np.concatenate([targets_p[:, None], negs], axis=1)
+            labels = jnp.asarray(
+                np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+            lt.syn0, lt.syn1neg, _ = _ns_step(
+                lt.syn0, lt.syn1neg, jnp.asarray(inputs_p),
+                jnp.asarray(tgt.astype(np.int32)), labels,
+                jnp.asarray(pair_mask), lr)
+
+    def _cbow_batch(self, ctx: np.ndarray, cmask: np.ndarray,
+                    centers: np.ndarray, alpha: float) -> None:
+        lt = self.lookup_table
+        B = self.batch_size
+        ctx_p, pair_mask = self._pad(ctx.astype(np.int32), B)
+        cmask_p, _ = self._pad(cmask, B)
+        centers_p, _ = self._pad(centers.astype(np.int32), B)
+        lr = jnp.float32(alpha)
+        if self.use_hs:
+            points, codes, hmask = self._code_arrays
+            lt.syn0, lt.syn1, _ = _cbow_hs_step(
+                lt.syn0, lt.syn1, jnp.asarray(ctx_p), jnp.asarray(cmask_p),
+                points[centers_p], codes[centers_p], hmask[centers_p],
+                jnp.asarray(pair_mask), lr)
+        if self.negative > 0:
+            table = lt.negative_table()
+            K = int(self.negative)
+            negs = table[self._rng.randint(0, table.size, (B, K))]
+            collide = negs == centers_p[:, None]
+            if collide.any():
+                negs[collide] = table[self._rng.randint(
+                    0, table.size, int(collide.sum()))]
+            tgt = np.concatenate([centers_p[:, None], negs], axis=1)
+            labels = jnp.asarray(
+                np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+            lt.syn0, lt.syn1neg, _ = _cbow_ns_step(
+                lt.syn0, lt.syn1neg, jnp.asarray(ctx_p),
+                jnp.asarray(cmask_p), jnp.asarray(tgt.astype(np.int32)),
+                labels, jnp.asarray(pair_mask), lr)
+
+    # --------------------------------------------------- WordVectors API
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        return (self.lookup_table.vector(word)
+                if self.lookup_table else None)
+
+    getWordVectorMatrix = word_vector  # reference-name alias
+
+    def similarity(self, w1: str, w2: str) -> float:
+        """Cosine similarity (reference ``similarity``); NaN if missing."""
+        a, b = self.word_vector(w1), self.word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(np.dot(a, b) / (na * nb))
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        """Nearest neighbors by cosine (reference ``wordsNearest``)."""
+        if isinstance(word_or_vec, str):
+            vec = self.word_vector(word_or_vec)
+            exclude = {word_or_vec}
+            if vec is None:
+                return []
+        else:
+            vec = np.asarray(word_or_vec)
+            exclude = set()
+        m = self.lookup_table.weights()
+        norms = np.linalg.norm(m, axis=1) * max(np.linalg.norm(vec), 1e-12)
+        sims = m @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            w = self.vocab.word_at_index(int(idx))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+
+class Word2Vec(SequenceVectors):
+    """Word2Vec over text corpora (reference ``models/word2vec/
+    Word2Vec.java``): a SequenceVectors whose sequences come from a sentence
+    iterator + tokenizer factory."""
+
+    def __init__(self, iterate=None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 stop_words: Sequence[str] = (), **kwargs):
+        kwargs.setdefault("min_word_frequency", 5)
+        super().__init__(**kwargs)
+        self.sentence_iterator = iterate
+        self.tokenizer_factory = tokenizer_factory \
+            or DefaultTokenizerFactory()
+        self.stop_words = set(stop_words)
+
+    # Builder parity (reference Word2Vec.Builder().iterate(...).build())
+    class Builder:
+        def __init__(self):
+            self._kw: Dict = {}
+            self._iterate = None
+            self._tokenizer = None
+
+        def __getattr__(self, name):
+            def setter(value):
+                self._kw[name] = value
+                return self
+            return setter
+
+        def iterate(self, it):
+            self._iterate = it
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tokenizer = tf
+            return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(iterate=self._iterate,
+                            tokenizer_factory=self._tokenizer, **self._kw)
+
+    def _sentences_to_sequences(self, sentences: Iterable[str]):
+        for sentence in sentences:
+            tokens = self.tokenizer_factory.create(sentence).get_tokens()
+            if self.stop_words:
+                tokens = [t for t in tokens if t not in self.stop_words]
+            if tokens:
+                yield tokens
+
+    def fit(self, sentences=None) -> "Word2Vec":
+        source = sentences if sentences is not None \
+            else self.sentence_iterator
+        if source is None:
+            raise ValueError("No sentence source; pass `iterate` or call "
+                             "fit(sentences)")
+        if isinstance(source, (list, tuple)) and source \
+                and not isinstance(source[0], str):
+            sequences = source  # pre-tokenized
+        else:
+            sequences = list(self._sentences_to_sequences(source))
+        return super().fit(sequences)

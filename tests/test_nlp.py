@@ -1,0 +1,341 @@
+"""NLP tier tests: tokenization, vocab/Huffman, Word2Vec end-to-end
+(small-corpus nearest-neighbor sanity — the reference's
+``Word2VecTestsSmall.java`` bar), serde round-trips."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CommonPreprocessor,
+                                    CollectionSentenceIterator,
+                                    DefaultTokenizerFactory,
+                                    NGramTokenizerFactory, VocabCache,
+                                    VocabConstructor, VocabWord, Word2Vec,
+                                    build_huffman_tree)
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+from deeplearning4j_tpu.nlp import serializer
+
+
+# A tiny corpus with sharp co-occurrence structure: day-words and
+# night-words never mix.
+DAY_WORDS = ["sun", "light", "morning", "noon"]
+NIGHT_WORDS = ["moon", "dark", "midnight", "stars"]
+
+
+def _corpus(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    sentences = []
+    for _ in range(n):
+        group = DAY_WORDS if rng.rand() < 0.5 else NIGHT_WORDS
+        sentences.append(list(rng.choice(group, 5)))
+    return sentences
+
+
+# ------------------------------------------------------------ tokenization
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    tokens = tf.create("Hello, World! 123 test's").get_tokens()
+    assert tokens == ["hello", "world", "tests"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(min_n=1, max_n=2)
+    tokens = tf.create("a b c").get_tokens()
+    assert tokens == ["a", "b", "c", "a b", "b c"]
+
+
+# ------------------------------------------------------------------ vocab
+
+def test_vocab_constructor_min_frequency_prune():
+    seqs = [["a", "a", "b"], ["a", "b", "c"]]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert cache.contains_word("a") and cache.contains_word("b")
+    assert not cache.contains_word("c")
+    assert cache.word_frequency("a") == 3
+    # indices sorted by frequency
+    assert cache.index_of("a") == 0
+
+
+def test_huffman_codes_prefix_free_and_frequency_ordered():
+    cache = VocabCache()
+    freqs = {"the": 100, "of": 60, "cat": 10, "dog": 8, "xylo": 1}
+    for w, f in freqs.items():
+        cache.add_token(VocabWord(w, f))
+    cache.finalize_vocab()
+    build_huffman_tree(cache)
+    words = cache.vocab_words()
+    codes = {w.word: "".join(map(str, w.codes)) for w in words}
+    # prefix-free
+    for w1, c1 in codes.items():
+        for w2, c2 in codes.items():
+            if w1 != w2:
+                assert not c2.startswith(c1)
+    # more frequent words get shorter (or equal) codes
+    assert len(codes["the"]) <= len(codes["xylo"])
+    # points are valid syn1 rows and aligned with codes
+    n = len(words)
+    for w in words:
+        assert len(w.points) == len(w.codes)
+        assert all(0 <= p <= n - 2 for p in w.points)
+        assert w.points[0] == n - 2  # root first
+
+
+# ------------------------------------------------------------- Word2Vec
+
+@pytest.mark.parametrize("mode", ["hs", "neg"])
+def test_word2vec_small_corpus_clusters(mode):
+    """Day words end up nearer each other than to night words — the
+    ``Word2VecTestsSmall`` sanity bar, for both HS and negative
+    sampling."""
+    vec = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                   learning_rate=0.05, epochs=3, seed=7,
+                   use_hierarchic_softmax=(mode == "hs"),
+                   negative=(5 if mode == "neg" else 0))
+    vec.fit(_corpus())
+    assert vec.has_word("sun") and vec.has_word("moon")
+    within = vec.similarity("sun", "morning")
+    across = vec.similarity("sun", "midnight")
+    assert within > across, (within, across)
+    nearest = vec.words_nearest("sun", 3)
+    assert set(nearest) <= set(DAY_WORDS), nearest
+
+
+def test_word2vec_cbow_learns_structure():
+    vec = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                   learning_rate=0.05, epochs=3, seed=7,
+                   elements_learning_algorithm="cbow")
+    vec.fit(_corpus())
+    assert vec.similarity("moon", "stars") > vec.similarity("moon", "noon")
+
+
+def test_word2vec_sentence_pipeline():
+    sentences = [" ".join(s) for s in _corpus(100)]
+    it = CollectionSentenceIterator(sentences)
+    vec = Word2Vec(iterate=it, layer_size=8, window_size=2,
+                   min_word_frequency=1, epochs=2, seed=3)
+    vec.fit()
+    assert vec.vocab.num_words() == 8
+    v = vec.word_vector("sun")
+    assert v is not None and v.shape == (8,)
+
+
+def test_word2vec_subsampling_and_builder():
+    vec = (Word2Vec.Builder()
+           .layer_size(8).window_size(2).min_word_frequency(1)
+           .sampling(1e-2).epochs(1).seed(1)
+           .build())
+    vec.fit(_corpus(50))
+    assert vec.vocab.num_words() == 8
+
+
+def test_unknown_word_handling():
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1)
+    vec.fit(_corpus(20))
+    assert vec.word_vector("zzz") is None
+    assert np.isnan(vec.similarity("sun", "zzz"))
+    assert not vec.has_word("zzz")
+
+
+# ---------------------------------------------------------------- serde
+
+def test_google_text_round_trip(tmp_path):
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=5)
+    vec.fit(_corpus(30))
+    path = str(tmp_path / "vectors.txt")
+    serializer.write_word_vectors(vec, path)
+    vocab, table = serializer.load_txt_vectors(path)
+    assert vocab.num_words() == vec.vocab.num_words()
+    for w in ["sun", "moon"]:
+        np.testing.assert_allclose(table.vector(w), vec.word_vector(w),
+                                   atol=1e-5)
+
+
+def test_google_binary_round_trip(tmp_path):
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=5)
+    vec.fit(_corpus(30))
+    path = str(tmp_path / "vectors.bin")
+    serializer.write_binary_word_vectors(vec, path)
+    vocab, table = serializer.load_binary_word_vectors(path)
+    assert vocab.num_words() == vec.vocab.num_words()
+    for w in ["sun", "stars"]:
+        np.testing.assert_allclose(table.vector(w), vec.word_vector(w),
+                                   rtol=1e-6)
+
+
+def test_full_model_round_trip_resumes_training(tmp_path):
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=5,
+                   negative=3, use_hierarchic_softmax=True)
+    corpus = _corpus(30)
+    vec.fit(corpus)
+    path = str(tmp_path / "model.zip")
+    serializer.write_full_model(vec, path)
+    restored = serializer.read_full_model(path)
+    np.testing.assert_allclose(restored.word_vector("sun"),
+                               vec.word_vector("sun"))
+    w = restored.vocab.word_for("sun")
+    assert w.codes  # Huffman state survived
+    # resume training on the restored model
+    restored.fit(corpus)
+    assert np.isfinite(restored.word_vector("sun")).all()
+
+
+# ------------------------------------------------------ SequenceVectors
+
+def test_sequence_vectors_on_abstract_sequences():
+    """SequenceVectors trains on arbitrary element sequences (the DeepWalk
+    consumption path)."""
+    rng = np.random.RandomState(0)
+    seqs = [[f"v{i}", f"v{(i + 1) % 6}", f"v{(i + 2) % 6}"]
+            for i in rng.randint(0, 6, 200)]
+    sv = SequenceVectors(layer_size=8, window_size=2, min_word_frequency=1,
+                         epochs=2, seed=2)
+    sv.fit(seqs)
+    assert sv.vocab.num_words() == 6
+    assert sv.word_vector("v0").shape == (8,)
+
+
+# ------------------------------------------------------ ParagraphVectors
+
+def test_paragraph_vectors_dbow_classifies_docs():
+    """DBOW doc vectors separate day-docs from night-docs (reference
+    ``ParagraphVectorsTest`` classifier behavior)."""
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+
+    rng = np.random.RandomState(0)
+    docs = []
+    for i in range(40):
+        group = DAY_WORDS if i % 2 == 0 else NIGHT_WORDS
+        label = "DAY" if i % 2 == 0 else "NIGHT"
+        docs.append((" ".join(rng.choice(group, 6)), label))
+    pv = ParagraphVectors(layer_size=16, window_size=3, epochs=5,
+                          learning_rate=0.05, seed=1,
+                          sequence_learning_algorithm="dbow")
+    pv.fit(docs)
+    assert pv.label_vector("DAY") is not None
+    # label vectors cluster with their words
+    day_sim = pv.similarity("DAY", "sun")
+    night_sim = pv.similarity("DAY", "moon")
+    assert day_sim > night_sim
+    # inference + predict on a fresh doc
+    pred = pv.predict(" ".join(rng.choice(DAY_WORDS, 6)))
+    assert pred == "DAY"
+
+
+def test_paragraph_vectors_dm_runs():
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+
+    rng = np.random.RandomState(1)
+    docs = [(" ".join(rng.choice(DAY_WORDS + NIGHT_WORDS, 5)), f"D{i}")
+            for i in range(10)]
+    pv = ParagraphVectors(layer_size=8, window_size=2, epochs=2, seed=2,
+                          sequence_learning_algorithm="dm")
+    pv.fit(docs)
+    for i in range(10):
+        assert pv.label_vector(f"D{i}").shape == (8,)
+
+
+# ----------------------------------------------------------------- GloVe
+
+def test_glove_learns_cooccurrence_structure():
+    from deeplearning4j_tpu.nlp import Glove
+
+    g = Glove(layer_size=16, window_size=3, min_word_frequency=1,
+              epochs=30, seed=4, x_max=10.0, batch_size=256)
+    g.fit(_corpus(200))
+    assert g.similarity("sun", "noon") > g.similarity("sun", "stars")
+
+
+# ------------------------------------------------------------ vectorizers
+
+def test_bag_of_words_vectorizer():
+    from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+
+    v = BagOfWordsVectorizer(min_word_frequency=1)
+    texts = ["cat sat mat", "cat cat dog"]
+    m = v.fit_transform(texts)
+    assert m.shape == (2, 4)
+    assert m[1, v.vocab.index_of("cat")] == 2.0
+    ds = v.vectorize(texts, [0, 1], 2)
+    assert ds.features.shape == (2, 4)
+    assert ds.labels.shape == (2, 2)
+
+
+def test_tfidf_vectorizer_downweights_common_words():
+    from deeplearning4j_tpu.nlp import TfidfVectorizer
+
+    v = TfidfVectorizer(min_word_frequency=1)
+    texts = ["common rare1", "common rare2", "common rare3"]
+    v.fit(texts)
+    vec = v.transform("common rare1")
+    assert vec[v.vocab.index_of("common")] == pytest.approx(0.0)
+    assert vec[v.vocab.index_of("rare1")] > 0
+
+
+# ----------------------------------------------------- sentence iterators
+
+def test_cnn_sentence_iterator_shapes():
+    from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                        CollectionLabeledSentenceProvider)
+
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=5)
+    vec.fit(_corpus(30))
+    sentences = ["sun light noon", "moon dark stars midnight"]
+    provider = CollectionLabeledSentenceProvider(sentences, ["d", "n"])
+    it = CnnSentenceDataSetIterator(vec, provider, batch_size=2,
+                                    format="cnn")
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 4, 8, 1)
+    assert ds.labels.shape == (2, 2)
+
+    it_rnn = CnnSentenceDataSetIterator(vec, provider, batch_size=2,
+                                        format="rnn")
+    ds2 = next(iter(it_rnn))
+    assert ds2.features.shape == (2, 4, 8)
+    assert ds2.features_mask.shape == (2, 4)
+    assert ds2.features_mask[0].sum() == 3  # 3-token sentence padded to 4
+
+
+def test_rnn_trains_on_word_vector_iterator():
+    """End-to-end: Word2Vec vectors -> RNN-format iterator -> LSTM
+    classifier learns to separate the two topics."""
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                        CollectionLabeledSentenceProvider)
+    from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+
+    rng = np.random.RandomState(0)
+    sentences, labels = [], []
+    for _ in range(60):
+        if rng.rand() < 0.5:
+            sentences.append(" ".join(rng.choice(DAY_WORDS, 4)))
+            labels.append("day")
+        else:
+            sentences.append(" ".join(rng.choice(NIGHT_WORDS, 4)))
+            labels.append("night")
+    vec = Word2Vec(layer_size=8, min_word_frequency=1, epochs=2, seed=5)
+    vec.fit(_corpus(100))
+    provider = CollectionLabeledSentenceProvider(sentences, labels)
+    it = CnnSentenceDataSetIterator(vec, provider, batch_size=20,
+                                    format="rnn")
+
+    conf = (NeuralNetConfiguration.builder().seed(12345)
+            .updater("adam").learning_rate(0.02).weight_init("xavier")
+            .activation("tanh").list()
+            .layer(GravesLSTM(n_in=8, n_out=12))
+            .layer(GlobalPoolingLayer(pooling_type="max"))
+            .layer(OutputLayer(n_in=12, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=25)
+    correct = total = 0
+    for ds in it:
+        out = net.output(ds.features, features_mask=ds.features_mask)
+        correct += (out.argmax(1) == np.asarray(ds.labels).argmax(1)).sum()
+        total += out.shape[0]
+    assert correct / total > 0.9
