@@ -65,22 +65,23 @@ def _hs_step(syn0: Array, syn1: Array, inputs: Array, points: Array,
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _ns_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
-             labels: Array, pair_mask: Array, lr: Array):
+             labels: Array, target_mask: Array, pair_mask: Array, lr: Array):
     """Negative-sampling batch update (the ``AggregateSkipGram`` role).
 
     targets (B, 1+K): positive word then K negatives; labels (1+K,) is
-    [1, 0, ..., 0].
+    [1, 0, ..., 0].  target_mask (B, 1+K) zeroes residual negative-sample
+    collisions with the positive (word2vec skips target==positive draws).
     """
     h = syn0[inputs]                                   # (B, D)
     w = syn1neg[targets]                               # (B, 1+K, D)
     logits = jnp.einsum("bd,bkd->bk", h, w)
-    g = (labels[None, :] - jax.nn.sigmoid(logits)) * pair_mask[:, None] * lr
+    mask = target_mask * pair_mask[:, None]
+    g = (labels[None, :] - jax.nn.sigmoid(logits)) * mask * lr
     dh = jnp.einsum("bk,bkd->bd", g, w)
     syn1neg = syn1neg.at[targets].add(g[:, :, None] * h[:, None, :])
     syn0 = syn0.at[inputs].add(dh)
     loss = -jnp.sum(jax.nn.log_sigmoid(
-        jnp.where(labels[None, :] > 0, logits, -logits))
-        * pair_mask[:, None])
+        jnp.where(labels[None, :] > 0, logits, -logits)) * mask)
     return syn0, syn1neg, loss
 
 
@@ -97,7 +98,9 @@ def _cbow_hs_step(syn0: Array, syn1: Array, contexts: Array,
     logits = jnp.einsum("bd,bld->bl", h, w)
     mask = code_mask * pair_mask[:, None]
     g = (1.0 - codes - jax.nn.sigmoid(logits)) * mask * lr
-    dh = jnp.einsum("bl,bld->bd", g, w) / counts       # (B, D)
+    # Only the forward hidden is averaged; the full neu1e is added to every
+    # context word (word2vec.c / reference AggregateCBOW semantics).
+    dh = jnp.einsum("bl,bld->bd", g, w)                # (B, D)
     syn1 = syn1.at[points].add(g[:, :, None] * h[:, None, :])
     syn0 = syn0.at[contexts].add(dh[:, None, :] * context_mask[:, :, None])
     loss = -jnp.sum(jax.nn.log_sigmoid((1.0 - 2.0 * codes) * logits) * mask)
@@ -107,19 +110,19 @@ def _cbow_hs_step(syn0: Array, syn1: Array, contexts: Array,
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _cbow_ns_step(syn0: Array, syn1neg: Array, contexts: Array,
                   context_mask: Array, targets: Array, labels: Array,
-                  pair_mask: Array, lr: Array):
+                  target_mask: Array, pair_mask: Array, lr: Array):
     cvecs = syn0[contexts]
     counts = jnp.maximum(jnp.sum(context_mask, axis=1, keepdims=True), 1.0)
     h = jnp.einsum("bcd,bc->bd", cvecs, context_mask) / counts
     w = syn1neg[targets]
     logits = jnp.einsum("bd,bkd->bk", h, w)
-    g = (labels[None, :] - jax.nn.sigmoid(logits)) * pair_mask[:, None] * lr
-    dh = jnp.einsum("bk,bkd->bd", g, w) / counts
+    mask = target_mask * pair_mask[:, None]
+    g = (labels[None, :] - jax.nn.sigmoid(logits)) * mask * lr
+    dh = jnp.einsum("bk,bkd->bd", g, w)
     syn1neg = syn1neg.at[targets].add(g[:, :, None] * h[:, None, :])
     syn0 = syn0.at[contexts].add(dh[:, None, :] * context_mask[:, :, None])
     loss = -jnp.sum(jax.nn.log_sigmoid(
-        jnp.where(labels[None, :] > 0, logits, -logits))
-        * pair_mask[:, None])
+        jnp.where(labels[None, :] > 0, logits, -logits)) * mask)
     return syn0, syn1neg, loss
 
 
@@ -322,6 +325,25 @@ class SequenceVectors:
         pad = np.zeros((size - n,) + arr.shape[1:], arr.dtype)
         return np.concatenate([arr, pad]), mask
 
+    def _draw_negatives(self, positives: np.ndarray, B: int):
+        """Draw K negatives per row from the unigram table; collisions with
+        the positive are resampled once, residual collisions are masked out
+        entirely (word2vec skips target==positive draws)."""
+        table = self.lookup_table.negative_table()
+        K = int(self.negative)
+        negs = table[self._rng.randint(0, table.size, (B, K))]
+        collide = negs == positives[:, None]
+        if collide.any():
+            negs[collide] = table[self._rng.randint(
+                0, table.size, int(collide.sum()))]
+        tgt = np.concatenate([positives[:, None], negs], axis=1)
+        tmask = np.ones((B, 1 + K), np.float32)
+        tmask[:, 1:] = (negs != positives[:, None]).astype(np.float32)
+        labels = jnp.asarray(
+            np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+        return (jnp.asarray(tgt.astype(np.int32)), labels,
+                jnp.asarray(tmask))
+
     def _skipgram_batch(self, inputs: np.ndarray, targets: np.ndarray,
                         alpha: float) -> None:
         lt = self.lookup_table
@@ -336,22 +358,10 @@ class SequenceVectors:
                 points[targets_p], codes[targets_p], cmask[targets_p],
                 jnp.asarray(pair_mask), lr)
         if self.negative > 0:
-            table = lt.negative_table()
-            K = int(self.negative)
-            negs = table[self._rng.randint(0, table.size, (B, K))]
-            # negatives that collide with the positive are masked by
-            # resampling once (word2vec just skips them)
-            collide = negs == targets_p[:, None]
-            if collide.any():
-                negs[collide] = table[self._rng.randint(
-                    0, table.size, int(collide.sum()))]
-            tgt = np.concatenate([targets_p[:, None], negs], axis=1)
-            labels = jnp.asarray(
-                np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+            tgt, labels, tmask = self._draw_negatives(targets_p, B)
             lt.syn0, lt.syn1neg, _ = _ns_step(
-                lt.syn0, lt.syn1neg, jnp.asarray(inputs_p),
-                jnp.asarray(tgt.astype(np.int32)), labels,
-                jnp.asarray(pair_mask), lr)
+                lt.syn0, lt.syn1neg, jnp.asarray(inputs_p), tgt, labels,
+                tmask, jnp.asarray(pair_mask), lr)
 
     def _cbow_batch(self, ctx: np.ndarray, cmask: np.ndarray,
                     centers: np.ndarray, alpha: float) -> None:
@@ -368,20 +378,11 @@ class SequenceVectors:
                 points[centers_p], codes[centers_p], hmask[centers_p],
                 jnp.asarray(pair_mask), lr)
         if self.negative > 0:
-            table = lt.negative_table()
-            K = int(self.negative)
-            negs = table[self._rng.randint(0, table.size, (B, K))]
-            collide = negs == centers_p[:, None]
-            if collide.any():
-                negs[collide] = table[self._rng.randint(
-                    0, table.size, int(collide.sum()))]
-            tgt = np.concatenate([centers_p[:, None], negs], axis=1)
-            labels = jnp.asarray(
-                np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+            tgt, labels, tmask = self._draw_negatives(centers_p, B)
             lt.syn0, lt.syn1neg, _ = _cbow_ns_step(
                 lt.syn0, lt.syn1neg, jnp.asarray(ctx_p),
-                jnp.asarray(cmask_p), jnp.asarray(tgt.astype(np.int32)),
-                labels, jnp.asarray(pair_mask), lr)
+                jnp.asarray(cmask_p), tgt, labels, tmask,
+                jnp.asarray(pair_mask), lr)
 
     # --------------------------------------------------- WordVectors API
     def has_word(self, word: str) -> bool:

@@ -76,7 +76,8 @@ class ComputationGraph:
             self.params[n] = layer.init_params(k, dtype)
             self.net_state[n] = layer.init_state(dtype)
             self.updater_state[n] = _updaters.init_state(
-                self._updater_conf(n), self.params[n])
+                self._updater_conf(n),
+                _updaters.updatable_params(layer, self.params[n]))
         self._init_done = True
         return self
 
@@ -209,17 +210,10 @@ class ComputationGraph:
             layer = self.vertices[name].layer
             g = grads[name]
             if g:
-                g = _updaters.regularize(g, params[name], layer.l1_by_param(),
-                                         layer.l2_by_param())
-                g = _updaters.normalize_gradients(
-                    g, layer.gradient_normalization,
-                    layer.gradient_normalization_threshold)
-                updates, ustate = _updaters.compute_update(
-                    self._updater_conf(name), g, updater_state[name],
-                    iteration)
-                new_params[name] = jax.tree.map(
-                    lambda p, u: p - u, params[name], updates)
-                new_ustate[name] = ustate
+                new_params[name], new_ustate[name] = \
+                    _updaters.apply_layer_updates(
+                        self._updater_conf(name), layer, params[name],
+                        updater_state[name], g, iteration)
             else:
                 new_params[name] = params[name]
                 new_ustate[name] = updater_state[name]

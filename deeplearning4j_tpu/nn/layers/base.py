@@ -94,6 +94,13 @@ class BaseLayerConfig:
                 mask: Optional[Array] = None) -> Tuple[Array, StateTree]:
         raise NotImplementedError
 
+    def direct_update_params(self) -> tuple[str, ...]:
+        """Param names whose gradient is applied directly (``p -= g``),
+        bypassing lr/updater/grad-norm — the reference's ``Updater.NONE`` +
+        lr 1.0 per-param override (e.g. center-loss cL,
+        ``CenterLossOutputLayer.getUpdaterByParam``)."""
+        return ()
+
     # ---- regularization wiring ------------------------------------------
     def l1_by_param(self) -> Dict[str, float]:
         out = {}

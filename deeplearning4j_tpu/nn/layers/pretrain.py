@@ -168,13 +168,15 @@ class RBM(BasePretrainLayer):
     def prop_down_pre(self, params: ParamTree, h: Array) -> Array:
         return h @ params["W"].T + params["vb"]
 
-    def prop_down(self, params: ParamTree, h: Array) -> Array:
-        pre = self.prop_down_pre(params, h)
+    def _visible_act(self, pre: Array) -> Array:
         if self.visible_unit == "binary":
             return jax.nn.sigmoid(pre)
         if self.visible_unit == "gaussian":
             return pre
         raise ValueError(f"Unsupported visible unit {self.visible_unit!r}")
+
+    def prop_down(self, params: ParamTree, h: Array) -> Array:
+        return self._visible_act(self.prop_down_pre(params, h))
 
     def _sample_h(self, rng, hprob: Array) -> Array:
         if self.hidden_unit == "binary":
@@ -200,8 +202,10 @@ class RBM(BasePretrainLayer):
         hsamp = self._sample_h(keys[0], hprob0)
         vprob = x
         hprob = hprob0
+        pre_vk = x
         for step in range(self.k):
-            vprob = self.prop_down(params, hsamp)
+            pre_vk = self.prop_down_pre(params, hsamp)
+            vprob = self._visible_act(pre_vk)
             vsamp = (self._sample_v(keys[2 * step + 1], vprob)
                      if self.visible_unit == "binary" else vprob)
             hprob = self.prop_up(params, vsamp)
@@ -214,9 +218,9 @@ class RBM(BasePretrainLayer):
             "b": -jnp.mean(hprob0 - hk, axis=0),
             "vb": -jnp.mean(x - vk, axis=0),
         }
-        # Monitored score: reconstruction error against the configured loss
-        # (reference setScoreWithZ(negVSamples)).
-        pre_vk = self.prop_down_pre(params, hsamp)
+        # Monitored score: reconstruction error against the chain's last
+        # negative visible phase v_k (reference setScoreWithZ(negVSamples)) —
+        # NOT one extra half-step from the post-loop hidden sample.
         act = "sigmoid" if self.visible_unit == "binary" else "identity"
         score = _losses.score(self.loss if self.visible_unit == "binary"
                               else "mse", x, pre_vk, act, None, True)

@@ -10,10 +10,13 @@ per-class centers, shape (nClasses=n_out, n_in)).
 Loss = supervised loss + (lambda/2) * ||features - center_{label}||^2.
 
 The reference updates centers with their own EMA rate ``alpha`` rather than
-the optimizer's learning rate: here that is expressed with a split loss —
-the feature path sees the lambda-scaled term against frozen centers, the
-center path sees an alpha-scaled term against frozen features — so one
-``jax.grad`` produces exactly the reference's two update rules inside the
+the optimizer's learning rate: cL uses ``Updater.NONE`` with lr 1.0 and the
+applied delta is ``deltaC_j = alpha * sum_{i: y_i=j}(c_j - x_i) /
+(count_j + 1)``.  Here that is expressed with a split loss — the feature
+path sees the lambda-scaled term against frozen centers (flows through the
+normal updater), while the center path is a zero-valued gradient carrier
+whose ``jax.grad`` equals deltaC exactly; ``direct_update_params`` then
+routes cL around the updater so ``cL -= deltaC`` verbatim — all inside the
 same XLA program.  With ``gradient_check=True`` both paths use the exact
 lambda-scaled term (full gradient flow), which is what the numerical
 gradient checker expects (reference ``gradientCheck`` flag).
@@ -62,6 +65,11 @@ class CenterLossOutputLayer(FeedForwardLayerConfig):
             "cL": jnp.zeros((self.n_out, self.n_in), dtype),
         }
 
+    def direct_update_params(self) -> tuple[str, ...]:
+        # cL bypasses lr/updater entirely (reference Updater.NONE + lr 1.0);
+        # under gradient_check the full-flow gradient is used instead.
+        return () if self.gradient_check else ("cL",)
+
     def l1_by_param(self):
         # Centers are not regularized (reference excludes cL from l1/l2).
         return {"W": self.l1 or 0.0, "b": self.l1_bias or 0.0, "cL": 0.0}
@@ -84,26 +92,33 @@ class CenterLossOutputLayer(FeedForwardLayerConfig):
         supervised = _losses.score(self.loss, labels, preout,
                                    self.activation, mask, average)
         centers = params["cL"].astype(x.dtype)
-        assigned = labels.astype(x.dtype) @ centers      # (batch, n_in)
-        if self.gradient_check:
-            diff_sq = jnp.sum((x - assigned) ** 2, axis=-1)
-            center_term = 0.5 * self.lambda_ * diff_sq
-        else:
-            # Split paths: lambda-scaled pull on features (centers frozen),
-            # alpha-scaled pull on centers (features frozen) — one jax.grad
-            # yields the reference's asymmetric update rules.
-            feat_term = 0.5 * self.lambda_ * jnp.sum(
-                (x - jax.lax.stop_gradient(assigned)) ** 2, axis=-1)
-            cent_term = 0.5 * self.alpha * jnp.sum(
-                (jax.lax.stop_gradient(x) - assigned) ** 2, axis=-1)
-            # Report only the lambda term in the score; the alpha term is a
-            # gradient carrier whose value is excluded via stop_gradient
-            # algebra below.
-            center_term = feat_term + cent_term \
-                - jax.lax.stop_gradient(cent_term)
+        lab = labels.astype(x.dtype)
         if mask is not None:
-            m = mask.reshape(center_term.shape)
-            center_term = center_term * m
-        total_center = (jnp.mean(center_term) if average
-                        else jnp.sum(center_term))
-        return supervised + total_center
+            lab = lab * mask.reshape(lab.shape[0], *([1] * (lab.ndim - 1)))
+        assigned = lab @ centers                         # (batch, n_in)
+        if self.gradient_check:
+            center_term = 0.5 * self.lambda_ * jnp.sum(
+                (x - assigned) ** 2, axis=-1)
+            if mask is not None:
+                center_term = center_term * mask.reshape(center_term.shape)
+            total_center = (jnp.mean(center_term) if average
+                            else jnp.sum(center_term))
+            return supervised + total_center
+        # Feature path: lambda-scaled pull toward frozen centers, averaged
+        # with the supervised loss (flows to W/b/earlier layers).
+        feat_term = 0.5 * self.lambda_ * jnp.sum(
+            (x - jax.lax.stop_gradient(assigned)) ** 2, axis=-1)
+        if mask is not None:
+            feat_term = feat_term * mask.reshape(feat_term.shape)
+        total_center = jnp.mean(feat_term) if average else jnp.sum(feat_term)
+        # Center path: zero-valued carrier whose gradient wrt cL is exactly
+        # the reference delta alpha * labels^T(center - feature) with
+        # per-class 1/(count_c + 1) normalization (CenterLossOutputLayer
+        # .backpropGradient); NOT averaged over batch — direct_update_params
+        # applies it verbatim, mirroring Updater.NONE + lr 1.0.
+        counts = jnp.sum(lab, axis=0)                    # (n_out,)
+        w = lab @ (1.0 / (counts + 1.0))                 # (batch,)
+        carrier = 0.5 * self.alpha * jnp.sum(
+            w * jnp.sum((jax.lax.stop_gradient(x) - assigned) ** 2, axis=-1))
+        return (supervised + total_center
+                + carrier - jax.lax.stop_gradient(carrier))

@@ -65,7 +65,9 @@ class MultiLayerNetwork:
         ]
         self.net_state = [layer.init_state(dtype) for layer in self.layers]
         self.updater_state = [
-            _updaters.init_state(self._updater_conf(i), self.params[i])
+            _updaters.init_state(
+                self._updater_conf(i),
+                _updaters.updatable_params(self.layers[i], self.params[i]))
             for i in range(len(self.layers))
         ]
         self._init_done = True
@@ -181,18 +183,12 @@ class MultiLayerNetwork:
         per-param update rule)."""
         new_params, new_updater_state = [], []
         for i, layer in enumerate(self.layers):
-            uconf = self._updater_conf(i)
             g = grads[i]
             if g:
-                g = _updaters.regularize(g, params[i], layer.l1_by_param(),
-                                         layer.l2_by_param())
-                g = _updaters.normalize_gradients(
-                    g, layer.gradient_normalization,
-                    layer.gradient_normalization_threshold)
-                updates, ustate = _updaters.compute_update(
-                    uconf, g, updater_state[i], iteration)
-                new_params.append(jax.tree.map(
-                    lambda p, u: p - u, params[i], updates))
+                new_p, ustate = _updaters.apply_layer_updates(
+                    self._updater_conf(i), layer, params[i],
+                    updater_state[i], g, iteration)
+                new_params.append(new_p)
                 new_updater_state.append(ustate)
             else:
                 new_params.append(params[i])

@@ -256,6 +256,38 @@ def compute_update(conf: UpdaterConfig, grads: ParamTree, state: ParamTree,
     raise ValueError(f"Unknown updater '{conf.updater}'")
 
 
+def updatable_params(layer, params: ParamTree) -> ParamTree:
+    """Subset of a layer's params that go through the updater (excludes
+    ``direct_update_params`` — those have no updater state, mirroring the
+    reference's per-param ``Updater.NONE`` which is stateless)."""
+    direct = set(layer.direct_update_params())
+    if not direct:
+        return params
+    return {k: v for k, v in params.items() if k not in direct}
+
+
+def apply_layer_updates(uconf: UpdaterConfig, layer, params: ParamTree,
+                        state: ParamTree, grads: ParamTree,
+                        iteration: Array) -> tuple[ParamTree, ParamTree]:
+    """Full DL4J-order update for one layer's param tree: l1/l2 into grads,
+    gradient normalization, per-param updater rule — with any
+    ``layer.direct_update_params()`` routed around all of it and applied
+    verbatim (``p -= g``; reference per-param ``Updater.NONE`` + lr 1.0,
+    e.g. center-loss cL)."""
+    g = dict(grads)
+    g_direct = {k: g.pop(k) for k in layer.direct_update_params() if k in g}
+    g = regularize(g, params, layer.l1_by_param(), layer.l2_by_param())
+    g = normalize_gradients(g, layer.gradient_normalization,
+                            layer.gradient_normalization_threshold)
+    updates, new_state = compute_update(uconf, g, state, iteration)
+    new_params = dict(params)
+    for k, u in updates.items():
+        new_params[k] = params[k] - u
+    for k, gd in g_direct.items():
+        new_params[k] = params[k] - gd
+    return new_params, new_state
+
+
 def regularize(grads: ParamTree, params: ParamTree,
                l1_by_param: Dict[str, float],
                l2_by_param: Dict[str, float]) -> ParamTree:

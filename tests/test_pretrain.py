@@ -314,6 +314,34 @@ def test_center_loss_centers_move_toward_class_means():
     assert centers[1].mean() < -1.0
 
 
+def test_center_loss_exact_reference_delta():
+    """Centers update by exactly deltaC = alpha * sum_c(center - x) /
+    (count_c + 1), independent of lr and updater (reference applies
+    Updater.NONE + lr 1.0 to the CENTER_KEY param)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 4)
+    cls = np.array([0, 0, 0, 1, 1, 2, 2, 2])
+    y = np.eye(3)[cls]
+    # adam + lr=7.0: if cL were routed through the updater the step would be
+    # wildly different from the analytic delta below.
+    conf = (_builder(activation="softmax", updater="adam",
+                     learning_rate=7.0).list()
+            .layer(CenterLossOutputLayer(n_in=4, n_out=3, alpha=0.3,
+                                         lambda_=0.0, loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    before = np.asarray(net.params[0]["cL"]).copy()
+    net.fit(DataSet(x, y))
+    after = np.asarray(net.params[0]["cL"])
+    for c in range(3):
+        members = x[cls == c]
+        delta = 0.3 * (before[c] - members).sum(axis=0) / (len(members) + 1)
+        np.testing.assert_allclose(after[c], before[c] - delta, atol=1e-5)
+    # cL carries no updater state (reference Updater.NONE is stateless)
+    assert all("cL" not in tree.get(k, {})
+               for tree in net.updater_state for k in tree)
+
+
 def test_center_loss_affects_training_loss():
     ds = _data()
     conf_plain = (_builder(activation="tanh").list()
