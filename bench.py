@@ -1,79 +1,283 @@
-"""Benchmark harness: LeNet-5 MNIST training throughput (samples/sec/chip).
+"""Benchmark harness for the BASELINE.md configs.
 
-North-star metric #1 from BASELINE.md.  The reference publishes no numbers
-(BASELINE.json ``"published": {}``); its instrumentation is
-``PerformanceListener.java:99-102`` (samples/sec).  The baseline constant
-below is this repo's own recorded CPU-XLA floor, so ``vs_baseline`` tracks
-improvement across rounds on the same config.
-
-Prints exactly ONE JSON line:
+Default run (the driver contract): LeNet-5 MNIST training throughput,
+printed as exactly ONE JSON line
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``--all`` additionally benchmarks the other BASELINE configs (ResNet-50,
+GravesLSTM char-RNN, word2vec skip-gram pairs/sec) and — in a CPU
+subprocess with a virtual 8-device mesh — the ParallelWrapper scaling
+harness; those extra lines go to stderr so stdout stays one line.
+
+Measurement notes: the round-1/2 harness timed 40 host dispatches (~6 ms of
+device work) against a tunneled TPU, which made the number dispatch-latency
+bound and noisy (±20% run to run).  This harness (a) runs the training loop
+ON-CHIP via the scan-based ``fit_scan`` multi-step (one dispatch = STEPS
+sequential SGD steps — reference ``StochasticGradientDescent.java:50-72``
+does this loop on the host), and (b) reports the best of TRIALS timed
+dispatches, so the metric tracks MXU throughput, not tunnel latency.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-# Recorded floor for this config (see BASELINE.md "Generated baselines"):
+# Recorded floor for the LeNet config (BASELINE.md "Generated baselines"):
 # round-1 CPU-XLA floor on this image (the reference publishes no numbers).
 BASELINE_SAMPLES_PER_SEC = 1488.0
 
-BATCH = 256
-WARMUP_STEPS = 3
-TIMED_STEPS = 40
+
+def _bf16_if_tpu():
+    import jax
+    return "bfloat16" if any(d.platform == "tpu"
+                             for d in jax.devices()) else None
 
 
-def main() -> None:
+def _best_of(fn, trials: int) -> float:
+    """Run ``fn`` (returns elapsed seconds) ``trials`` times, return the
+    minimum elapsed."""
+    return min(fn() for _ in range(trials))
+
+
+def bench_lenet(batch: int = 256, steps: int = 50, trials: int = 3) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.datasets.mnist import mnist_arrays
     from deeplearning4j_tpu.models.lenet import lenet
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.datasets.mnist import mnist_arrays
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    # bfloat16 compute on TPU keeps matmuls/convs on the MXU fast path.
-    conf = lenet(compute_dtype="bfloat16" if on_tpu else None)
+    conf = lenet(compute_dtype=_bf16_if_tpu())
     net = MultiLayerNetwork(conf).init()
 
-    features, labels = mnist_arrays(train=True, num_examples=BATCH * 8)
-    features = jnp.asarray(features)
-    labels = jnp.asarray(labels)
-    n_batches = features.shape[0] // BATCH
-    batches = [
-        (features[i * BATCH:(i + 1) * BATCH], labels[i * BATCH:(i + 1) * BATCH])
-        for i in range(n_batches)
-    ]
+    features, labels = mnist_arrays(train=True, num_examples=batch * 8)
+    n = features.shape[0] // batch
+    # stack the 8 distinct minibatches cyclically into (steps, B, ...) and
+    # stage them on-device ONCE — the timed region measures the on-chip
+    # scan, not host->device transfer over the tunnel
+    idx = [i % n for i in range(steps)]
+    f_stk = jnp.asarray(np.stack(
+        [features[i * batch:(i + 1) * batch] for i in idx]))
+    l_stk = jnp.asarray(np.stack(
+        [labels[i * batch:(i + 1) * batch] for i in idx]))
+    jax.block_until_ready((f_stk, l_stk))
 
-    def step(i: int) -> None:
-        f, l = batches[i % n_batches]
-        (net.params, net.updater_state, net.net_state, score) = net._train_step(
+    def dispatch() -> float:
+        (net.params, net.updater_state, net.net_state,
+         scores) = net._multi_train_step(
             net.params, net.updater_state, net.net_state, net.iteration,
-            f, l, None, None, net._rng_key)
+            f_stk, l_stk, None, None, net._rng_key)
+        net.iteration += steps
+        # device->host fetch: the only reliable completion barrier over the
+        # tunneled TPU (block_until_ready returns early on remote arrays)
+        return float(np.asarray(scores)[-1])
+
+    dispatch()                     # warmup: compile + first run
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        dispatch()
+        return time.perf_counter() - t0
+
+    elapsed = _best_of(timed, trials)
+    sps = steps * batch / elapsed
+    return {
+        "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+    }
+
+
+def bench_resnet50(batch: int = 32, steps: int = 8, trials: int = 3) -> dict:
+    """ResNet-50 synthetic-ImageNet training step (BASELINE config #2) —
+    the real MXU test: conv-dominated, bf16 on TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    conf = resnet50(compute_dtype=_bf16_if_tpu())
+    net = ComputationGraph(conf).init()
+    rng = np.random.RandomState(0)
+    f = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    l = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, batch)])
+
+    def one_step():
+        (net.params, net.updater_state, net.net_state, score) = \
+            net._train_step(net.params, net.updater_state, net.net_state,
+                            net.iteration, [f], [l], None, None,
+                            net._rng_key)
         net.iteration += 1
         return score
 
-    for i in range(WARMUP_STEPS):
-        step(i)
-    jax.block_until_ready(net.params)
+    float(np.asarray(one_step()))   # warmup; fetch = completion barrier
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_STEPS):
-        score = step(i)
-    jax.block_until_ready(net.params)
-    elapsed = time.perf_counter() - t0
+    def timed() -> float:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            score = one_step()
+        float(np.asarray(score))
+        return time.perf_counter() - t0
 
-    samples_per_sec = TIMED_STEPS * BATCH / elapsed
-    print(json.dumps({
-        "metric": "lenet_mnist_train_samples_per_sec_per_chip",
-        "value": round(samples_per_sec, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
-    }))
+    elapsed = _best_of(timed, trials)
+    sps = steps * batch / elapsed
+    return {"metric": "resnet50_imagenet_train_samples_per_sec_per_chip",
+            "value": round(sps, 1), "unit": "samples/sec/chip",
+            "vs_baseline": None}
+
+
+def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
+               hidden: int = 256, steps: int = 20, trials: int = 3) -> dict:
+    """GravesLSTM char-RNN tBPTT step (BASELINE config #3): lax.scan over
+    time inside the jitted train step."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).updater("rmsprop").learning_rate(0.1)
+            .weight_init("xavier")
+            .list()
+            .layer(GravesLSTM(n_in=vocab, n_out=hidden, activation="tanh"))
+            .layer(GravesLSTM(n_in=hidden, n_out=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=hidden, n_out=vocab,
+                                  activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq))
+    f = np.eye(vocab, dtype=np.float32)[ids]
+    l = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    f_stk = jnp.asarray(np.broadcast_to(f, (steps,) + f.shape))
+    l_stk = jnp.asarray(np.broadcast_to(l, (steps,) + l.shape))
+    jax.block_until_ready((f_stk, l_stk))
+
+    def dispatch() -> float:
+        (net.params, net.updater_state, net.net_state,
+         scores) = net._multi_train_step(
+            net.params, net.updater_state, net.net_state, net.iteration,
+            f_stk, l_stk, None, None, net._rng_key)
+        net.iteration += steps
+        return float(np.asarray(scores)[-1])
+
+    dispatch()
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        dispatch()
+        return time.perf_counter() - t0
+
+    elapsed = _best_of(timed, trials)
+    chars = steps * batch * seq / elapsed
+    return {"metric": "graves_lstm_charnn_chars_per_sec_per_chip",
+            "value": round(chars, 1), "unit": "chars/sec/chip",
+            "vs_baseline": None}
+
+
+def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
+                   negative: int = 5, steps: int = 20,
+                   trials: int = 3) -> dict:
+    """Word2Vec skip-gram negative-sampling kernel throughput (BASELINE
+    config #4), pairs/sec through the XLA scatter-add kernel (the
+    ``AggregateSkipGram`` role)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.word2vec import _ns_step
+
+    rng = np.random.RandomState(0)
+    syn0 = jnp.asarray(rng.randn(vocab, dim).astype(np.float32) * 0.01)
+    syn1 = jnp.asarray(np.zeros((vocab, dim), np.float32))
+    inputs = jnp.asarray(rng.randint(0, vocab, batch).astype(np.int32))
+    targets = jnp.asarray(
+        rng.randint(0, vocab, (batch, 1 + negative)).astype(np.int32))
+    labels = jnp.asarray(np.concatenate(
+        [[1.0], np.zeros(negative)]).astype(np.float32))
+    tmask = jnp.ones((batch, 1 + negative), jnp.float32)
+    pmask = jnp.ones((batch,), jnp.float32)
+    lr = jnp.float32(0.025)
+
+    def run_once(s0, s1):
+        for _ in range(steps):
+            s0, s1, loss = _ns_step(s0, s1, inputs, targets, labels, tmask,
+                                    pmask, lr)
+        float(np.asarray(loss))     # fetch = completion barrier
+        return s0, s1
+
+    syn0, syn1 = run_once(syn0, syn1)
+
+    def timed() -> float:
+        nonlocal syn0, syn1
+        t0 = time.perf_counter()
+        syn0, syn1 = run_once(syn0, syn1)
+        return time.perf_counter() - t0
+
+    elapsed = _best_of(timed, trials)
+    pairs = steps * batch / elapsed
+    return {"metric": "word2vec_sgns_pairs_per_sec_per_chip",
+            "value": round(pairs, 1), "unit": "pairs/sec/chip",
+            "vs_baseline": None}
+
+
+def bench_scaling() -> dict:
+    """ParallelWrapper scaling efficiency 1→8 on a virtual CPU mesh, in a
+    subprocess (the TPU session only has one real chip; the CPU mesh is the
+    Spark-``local[N]`` analogue, SURVEY.md §4)."""
+    import os
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'\n"
+        "os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        "import json\n"
+        "from deeplearning4j_tpu.parallel.scaling import scaling_report\n"
+        "from deeplearning4j_tpu.models.lenet import lenet\n"
+        "from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork\n"
+        "rep = scaling_report(lambda: MultiLayerNetwork(lenet()),\n"
+        "                     [1, 2, 4, 8], batch_size=64, n_rounds=4)\n"
+        "print(json.dumps({'efficiency_8': rep[8]['efficiency'],\n"
+        "                  'report': rep}))\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=1200, env=env)
+    if out.returncode != 0:
+        return {"metric": "parallel_scaling_efficiency_1to8",
+                "value": None, "unit": "ratio",
+                "error": out.stderr.strip()[-500:]}
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    return {"metric": "parallel_scaling_efficiency_1to8",
+            "value": rep.get("efficiency_8"), "unit": "ratio",
+            "detail": rep, "vs_baseline": None}
+
+
+def main() -> None:
+    run_all = "--all" in sys.argv
+    result = bench_lenet()
+    print(json.dumps(result), flush=True)
+    if not run_all:
+        return
+    for fn in (bench_resnet50, bench_lstm, bench_word2vec, bench_scaling):
+        try:
+            print(json.dumps(fn()), file=sys.stderr, flush=True)
+        except Exception as e:  # keep going: one config failing is data too
+            print(json.dumps({"metric": fn.__name__, "error": repr(e)}),
+                  file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

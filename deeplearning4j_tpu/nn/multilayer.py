@@ -216,6 +216,79 @@ class MultiLayerNetwork:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
+    def _multi_train_step(self):
+        """S sequential train steps in ONE XLA program via ``lax.scan`` over
+        stacked (S, B, ...) batches.  The reference runs its inner loop on
+        the host (``StochasticGradientDescent.java:50-72``, one dispatch per
+        iteration); on TPU the scan keeps the whole loop on-chip, so
+        throughput is set by the MXU, not by host dispatch latency."""
+
+        def multi(params, updater_state, net_state, iteration, features,
+                  labels, features_mask, labels_mask, base_rng):
+            def body(carry, xs):
+                p, u, s, it = carry
+                f, l, fm, lm = xs
+                rng = jax.random.fold_in(base_rng, it)
+                (data_loss, (new_s, _)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(
+                        p, s, f, l, fm, lm, rng, True)
+                new_p, new_u = self._apply_updates(p, u, grads, it)
+                score = data_loss + self._reg_score(p)
+                return (new_p, new_u, new_s, it + 1), score
+
+            init = (params, updater_state, net_state,
+                    jnp.asarray(iteration, jnp.int32))
+            (params, updater_state, net_state, _), scores = jax.lax.scan(
+                body, init, (features, labels, features_mask, labels_mask))
+            return params, updater_state, net_state, scores
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def fit_scan(self, batches: Sequence[DataSet]) -> np.ndarray:
+        """Fit a list of same-shaped minibatches in one device dispatch
+        (scan-based inner loop).  Returns the per-step scores.  Listeners
+        fire once at the end with the final iteration — per-step host
+        callbacks would break the single-HLO hot loop.
+
+        Supports the standard-backprop regime only: configs using tBPTT,
+        pretraining, or ``num_iterations > 1`` must go through ``fit()``
+        (raises loudly rather than silently training differently)."""
+        self.init()
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError("fit_scan does not support tBPTT; use fit()")
+        if self.conf.pretrain and not self._pretrain_done:
+            raise ValueError("fit_scan does not run pretraining; call "
+                             "pretrain() (or fit()) first")
+        if self.conf.conf.num_iterations != 1:
+            raise ValueError("fit_scan runs one update per batch; "
+                             "num_iterations > 1 must use fit()")
+
+        def stack_masks(get):
+            present = [get(b) is not None for b in batches]
+            if not any(present):
+                return None
+            if not all(present):
+                raise ValueError(
+                    "Mixed mask presence across batches in fit_scan; "
+                    "provide masks on all batches or none")
+            return jnp.stack([jnp.asarray(get(b)) for b in batches])
+
+        features = jnp.stack([jnp.asarray(b.features) for b in batches])
+        labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        fmask = stack_masks(lambda b: b.features_mask)
+        lmask = stack_masks(lambda b: b.labels_mask)
+        (self.params, self.updater_state, self.net_state,
+         scores) = self._multi_train_step(
+            self.params, self.updater_state, self.net_state, self.iteration,
+            features, labels, fmask, lmask, self._rng_key)
+        self.iteration += len(batches)
+        self._score = scores[-1]
+        self.last_batch_size = batches[0].num_examples()
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return np.asarray(scores)
+
+    @functools.cached_property
     def _tbptt_step(self):
         """Truncated-BPTT window step (reference ``doTruncatedBPTT:1138``):
         one fwd+bwd+update over a time window, with recurrent state carried

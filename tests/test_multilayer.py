@@ -203,3 +203,23 @@ def test_clone_independent():
     net.fit(ds)
     # clone unchanged by original's training
     assert not np.allclose(net.get_flat_params(), other.get_flat_params())
+
+
+def test_fit_scan_matches_sequential_steps():
+    """The scan-based multi-step (one dispatch = S sequential SGD steps,
+    ``MultiLayerNetwork.fit_scan``) produces bitwise the same params as S
+    separate ``fit`` dispatches — it is an execution strategy, not a
+    different algorithm."""
+    ds = _toy_classification()
+    batches = [DataSet(ds.features[i * 32:(i + 1) * 32],
+                       ds.labels[i * 32:(i + 1) * 32]) for i in range(4)]
+    net_a = MultiLayerNetwork(_mlp_conf(updater="adam", lr=0.01)).init()
+    net_b = MultiLayerNetwork(_mlp_conf(updater="adam", lr=0.01)).init()
+    scores = net_a.fit_scan(batches)
+    for b in batches:
+        net_b.fit(b)
+    np.testing.assert_allclose(net_a.get_flat_params(),
+                               net_b.get_flat_params(), rtol=1e-6)
+    assert net_a.iteration == net_b.iteration == 4
+    assert scores.shape == (4,)
+    assert np.all(np.isfinite(scores))
