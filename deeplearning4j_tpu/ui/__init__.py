@@ -1,0 +1,26 @@
+"""Observability / UI tier.
+
+TPU-native equivalent of the reference's ``deeplearning4j-ui-parent`` +
+``deeplearning4j-core`` StatsStorage API (SURVEY.md §2.9, layer 10):
+
+- :mod:`storage` — ``StatsStorage`` SPI with in-memory and sqlite-file
+  backends (reference ``api/storage/StatsStorage.java``,
+  ``InMemoryStatsStorage``, ``J7FileStatsStorage``).
+- :mod:`stats_listener` — ``StatsListener`` training hook sampling score,
+  learning rates, throughput, per-param histograms/magnitudes and process
+  memory (reference ``ui/stats/BaseStatsListener.java``).
+- :mod:`server` — ``UIServer`` HTTP dashboard + remote stats receiver
+  (reference ``ui/play/PlayUIServer.java`` + ``module/train/TrainModule``,
+  ``RemoteUIStatsStorageRouter``).
+"""
+
+from .storage import (FileStatsStorage, InMemoryStatsStorage, Persistable,
+                      StatsStorage, StatsStorageRouter)
+from .stats_listener import StatsListener
+from .server import RemoteStatsStorageRouter, UIServer
+
+__all__ = [
+    "FileStatsStorage", "InMemoryStatsStorage", "Persistable",
+    "StatsStorage", "StatsStorageRouter", "StatsListener",
+    "RemoteStatsStorageRouter", "UIServer",
+]

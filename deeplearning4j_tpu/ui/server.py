@@ -1,0 +1,342 @@
+"""UIServer: training dashboard over a stats storage.
+
+TPU-native equivalent of the reference's
+``deeplearning4j-ui-parent/deeplearning4j-play/src/main/java/org/
+deeplearning4j/ui/play/PlayUIServer.java`` (implements ``ui/api/
+UIServer.java``: ``getInstance().attach(statsStorage)``) and the train
+dashboard module ``module/train/TrainModule.java`` (overview / model /
+system tabs), plus the remote-stats receiver path
+(``module/remote/`` + core ``api/storage/impl/RemoteUIStatsStorageRouter``:
+remote processes POST stats to a central UI).
+
+The Play framework + JS asset pipeline is replaced by a stdlib
+``ThreadingHTTPServer`` serving one self-contained HTML page (inline SVG
+charts, no external assets) and JSON data endpoints the page polls:
+
+    GET  /train/sessions            -> list of session ids
+    GET  /train/overview/data?sid=  -> score/throughput/lr/memory series
+    GET  /train/model/data?sid=     -> per-param magnitudes/ratios/histograms
+    POST /remote                    -> Persistable JSON (remote router)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .storage import (InMemoryStatsStorage, Persistable, StatsStorage,
+                      StatsStorageRouter)
+from .stats_listener import TYPE_ID
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+<style>
+body { font-family: sans-serif; margin: 1.5em; background: #fafafa; }
+h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.2em; }
+.chart { background: #fff; border: 1px solid #ddd; margin-bottom: 1em; }
+table { border-collapse: collapse; background: #fff; }
+td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 0.85em; }
+#meta { color: #666; font-size: 0.85em; }
+</style></head>
+<body>
+<h1>DL4J-TPU Training Dashboard</h1>
+<div id="meta"></div>
+<h2>Score vs iteration</h2>
+<svg id="score" class="chart" width="640" height="240"></svg>
+<h2>Update:param mean-magnitude ratio (log10)</h2>
+<svg id="ratios" class="chart" width="640" height="240"></svg>
+<h2>Throughput + memory</h2>
+<table id="sys"></table>
+<h2>Model</h2>
+<table id="model"></table>
+<script>
+function line(svg, series, labels) {
+  svg.innerHTML = '';
+  const W = svg.width.baseVal.value, H = svg.height.baseVal.value;
+  let xs = [], ys = [];
+  series.forEach(s => s.pts.forEach(p => { xs.push(p[0]); ys.push(p[1]); }));
+  if (!xs.length) return;
+  const x0 = Math.min(...xs), x1 = Math.max(...xs, x0 + 1);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys, y0 + 1e-9);
+  const X = v => 40 + (W - 50) * (v - x0) / (x1 - x0);
+  const Y = v => H - 20 - (H - 30) * (v - y0) / (y1 - y0);
+  const colors = ['#1976d2','#d32f2f','#388e3c','#f57c00','#7b1fa2',
+                  '#0097a7','#5d4037','#455a64'];
+  series.forEach((s, i) => {
+    const d = s.pts.map((p, j) => (j ? 'L' : 'M') + X(p[0]) + ',' + Y(p[1]))
+                   .join(' ');
+    const path = document.createElementNS('http://www.w3.org/2000/svg',
+                                          'path');
+    path.setAttribute('d', d); path.setAttribute('fill', 'none');
+    path.setAttribute('stroke', colors[i % colors.length]);
+    svg.appendChild(path);
+  });
+  [[x0, y0], [x1, y1]].forEach((p, i) => {
+    const t = document.createElementNS('http://www.w3.org/2000/svg','text');
+    t.setAttribute('x', i ? W - 90 : 2); t.setAttribute('y', H - 4);
+    t.setAttribute('font-size', '10');
+    t.textContent = i ? 'iter ' + p[0] : (y0.toPrecision(3) + ' .. '
+                                          + y1.toPrecision(3));
+    svg.appendChild(t);
+  });
+}
+async function refresh() {
+  const sids = await (await fetch('train/sessions')).json();
+  if (!sids.length) return;
+  const sid = sids[sids.length - 1];
+  const ov = await (await fetch('train/overview/data?sid=' + sid)).json();
+  document.getElementById('meta').textContent =
+    'session ' + sid + ' | ' + JSON.stringify(ov.static || {});
+  line(document.getElementById('score'),
+       [{pts: ov.score_vs_iter || []}]);
+  const md = await (await fetch('train/model/data?sid=' + sid)).json();
+  const rs = Object.entries(md.ratio_series || {}).map(
+    ([k, v]) => ({pts: v.map(p => [p[0], Math.log10(p[1] + 1e-12)])}));
+  line(document.getElementById('ratios'), rs);
+  document.getElementById('sys').innerHTML =
+    '<tr><th>samples/sec</th><th>batches/sec</th><th>rss MB</th></tr>' +
+    '<tr><td>' + (ov.samples_per_sec || '-') + '</td><td>' +
+    (ov.batches_per_sec || '-') + '</td><td>' +
+    (ov.memory_rss_mb || '-') + '</td></tr>';
+  document.getElementById('model').innerHTML =
+    '<tr><th>param</th><th>mean |w|</th><th>mean |dw|</th><th>ratio</th>'
+    + '</tr>' + Object.entries(md.params || {}).map(([k, v]) =>
+      '<tr><td>' + k + '</td><td>' + v.mean_mag.toPrecision(4) + '</td><td>'
+      + (v.update_mag || 0).toPrecision(4) + '</td><td>'
+      + (v.ratio || 0).toPrecision(4) + '</td></tr>').join('');
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPUUI/1.0"
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj) -> None:
+        self._send(200, json.dumps(obj).encode())
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # ---- GET routes ------------------------------------------------------
+    def do_GET(self):
+        ui: "UIServer" = self.server.ui            # type: ignore
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        sid = q.get("sid", [None])[0]
+        path = url.path.rstrip("/") or "/"
+        if path in ("/", "/train", "/train/overview"):
+            self._send(200, _PAGE.encode(), "text/html")
+        elif path == "/train/sessions":
+            self._json(ui.list_sessions())
+        elif path == "/train/overview/data":
+            self._json(ui.overview_data(sid))
+        elif path == "/train/model/data":
+            self._json(ui.model_data(sid))
+        else:
+            self._send(404, b'{"error": "not found"}')
+
+    # ---- POST /remote (RemoteUIStatsStorageRouter receiver) --------------
+    def do_POST(self):
+        ui: "UIServer" = self.server.ui            # type: ignore
+        if urlparse(self.path).path.rstrip("/") != "/remote":
+            self._send(404, b'{"error": "not found"}')
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        payload = json.loads(self.rfile.read(length).decode())
+        record = Persistable(**payload["record"])
+        if payload.get("kind") == "static":
+            ui.storage.put_static_info(record)
+        else:
+            ui.storage.put_update(record)
+        self._json({"status": "ok"})
+
+
+class UIServer:
+    """Reference ``UIServer.getInstance().attach(statsStorage)`` analogue.
+
+    ``start()`` binds a background HTTP server (port 0 = ephemeral);
+    ``attach`` points it at a storage to visualize (also the sink for
+    POSTed remote stats)."""
+
+    def __init__(self, storage: Optional[StatsStorage] = None,
+                 port: int = 9000):
+        self.storage = storage or InMemoryStatsStorage()
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        self.storage = storage
+        return self
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "UIServer":
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self._httpd.ui = self                       # type: ignore
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/train/overview"
+
+    # ---- data assembly (TrainModule.java role) ---------------------------
+    def list_sessions(self) -> List[str]:
+        return self.storage.list_session_ids()
+
+    def _updates(self, sid: Optional[str]) -> List[Persistable]:
+        if sid is None:
+            return []
+        out: List[Persistable] = []
+        for wid in self.storage.list_worker_ids(sid, TYPE_ID):
+            out.extend(self.storage.get_all_updates(sid, TYPE_ID, wid))
+        out.sort(key=lambda r: r.timestamp)
+        return out
+
+    def overview_data(self, sid: Optional[str]) -> dict:
+        updates = self._updates(sid)
+        data = {
+            "score_vs_iter": [[u.data["iteration"], u.data["score"]]
+                              for u in updates],
+        }
+        if updates:
+            last = updates[-1].data
+            for k in ("samples_per_sec", "batches_per_sec", "memory_rss_mb",
+                      "learning_rates", "iteration", "epoch"):
+                if k in last:
+                    data[k] = last[k]
+        if sid is not None:
+            for wid in self.storage.list_worker_ids(sid, TYPE_ID):
+                static = self.storage.get_static_info(sid, TYPE_ID, wid)
+                if static:
+                    data["static"] = {
+                        k: static.data.get(k)
+                        for k in ("backend", "device_kind", "model_class",
+                                  "num_params", "hostname")}
+                    break
+        return data
+
+    def model_data(self, sid: Optional[str]) -> dict:
+        updates = self._updates(sid)
+        ratio_series: dict = {}
+        params: dict = {}
+        for u in updates:
+            it = u.data["iteration"]
+            for name, r in u.data.get("update_param_ratios", {}).items():
+                ratio_series.setdefault(name, []).append([it, r])
+        if updates:
+            last = updates[-1].data
+            for name, mag in last.get("param_mean_magnitudes", {}).items():
+                params[name] = {
+                    "mean_mag": mag,
+                    "update_mag": last.get("update_mean_magnitudes",
+                                           {}).get(name),
+                    "ratio": last.get("update_param_ratios", {}).get(name),
+                    "histogram": last.get("param_histograms", {}).get(name),
+                }
+        return {"ratio_series": ratio_series, "params": params}
+
+
+class RemoteStatsStorageRouter(StatsStorageRouter):
+    """POST stats to a remote UIServer (reference core
+    ``api/storage/impl/RemoteUIStatsStorageRouter.java`` — the path Spark
+    executors use to feed a central dashboard).
+
+    Like the reference, posting is asynchronous with bounded retries: a
+    dashboard outage must never crash the training loop.  Records are
+    queued and shipped by a daemon thread; after ``max_retries`` failed
+    attempts a record is dropped with a warning (reference
+    ``RemoteUIStatsStorageRouter`` retry/shutdown semantics)."""
+
+    def __init__(self, url: str, timeout: float = 5.0,
+                 max_retries: int = 3, retry_backoff: float = 0.5,
+                 queue_size: int = 1000):
+        import logging
+        import queue
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self._log = logging.getLogger("deeplearning4j_tpu")
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self) -> None:
+        import time as _time
+        while True:
+            kind, record = self._queue.get()
+            for attempt in range(self.max_retries):
+                try:
+                    self._post(kind, record)
+                    break
+                except Exception as e:
+                    if attempt == self.max_retries - 1:
+                        self._log.warning(
+                            "RemoteStatsStorageRouter: dropping %s record "
+                            "after %d attempts (%r)", kind,
+                            self.max_retries, e)
+                    else:
+                        _time.sleep(self.retry_backoff * (2 ** attempt))
+            self._queue.task_done()
+
+    def _enqueue(self, kind: str, record: Persistable) -> None:
+        try:
+            self._queue.put_nowait((kind, record))
+        except Exception:
+            self._log.warning(
+                "RemoteStatsStorageRouter: queue full, dropping %s record",
+                kind)
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Block until queued records are shipped (tests / orderly exit)."""
+        import time as _time
+        deadline = _time.time() + timeout
+        while not self._queue.empty() and _time.time() < deadline:
+            _time.sleep(0.01)
+        self._queue.join()
+
+    def _post(self, kind: str, record: Persistable) -> None:
+        body = json.dumps({
+            "kind": kind,
+            "record": {
+                "session_id": record.session_id,
+                "type_id": record.type_id,
+                "worker_id": record.worker_id,
+                "timestamp": record.timestamp,
+                "data": record.data,
+            },
+        }).encode()
+        req = urllib.request.Request(
+            self.url + "/remote", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=self.timeout).read()
+
+    def put_static_info(self, record: Persistable) -> None:
+        self._enqueue("static", record)
+
+    def put_update(self, record: Persistable) -> None:
+        self._enqueue("update", record)

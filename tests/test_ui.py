@@ -1,0 +1,189 @@
+"""Observability-stack tests: StatsListener -> StatsStorage -> UIServer.
+
+Mirrors the reference test trio (SURVEY.md §4):
+``TestStatsListener.java`` (listener posts init + update records),
+``TestStatsStorage.java`` (every storage backend round-trips records),
+``TestPlayUI.java`` (HTTP server smoke tests), plus the remote-router path
+(``RemoteUIStatsStorageRouter``)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   Persistable, RemoteStatsStorageRouter,
+                                   StatsListener, UIServer)
+from deeplearning4j_tpu.ui.stats_listener import TYPE_ID
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+# ---------------------------------------------------------- TestStatsListener
+
+def test_stats_listener_posts_init_and_updates():
+    storage = InMemoryStatsStorage()
+    listener = StatsListener(storage, update_frequency=2)
+    net = _net()
+    net.add_listener(listener)
+    net.fit(_data(), epochs=10)          # 10 iterations
+
+    sid = listener.session_id
+    assert storage.list_session_ids() == [sid]
+    static = storage.get_static_info(sid, TYPE_ID, "worker_0")
+    assert static is not None
+    assert static.data["model_class"] == "MultiLayerNetwork"
+    assert static.data["num_params"] == net.num_params()
+    assert static.data["backend"] == "cpu"
+
+    updates = storage.get_all_updates(sid, TYPE_ID, "worker_0")
+    assert len(updates) == 5             # every 2nd of 10 iterations
+    first, last = updates[0].data, updates[-1].data
+    assert first["iteration"] == 2 and last["iteration"] == 10
+    assert np.isfinite(first["score"])
+    assert first["learning_rates"] == {"0": pytest.approx(0.1),
+                                       "1": pytest.approx(0.1)}
+    # param stats cover every named param
+    assert set(first["param_mean_magnitudes"]) == {"0_W", "0_b", "1_W",
+                                                   "1_b"}
+    # update magnitudes appear from the 2nd report on (windowed delta)
+    assert "update_param_ratios" in last
+    assert last["update_param_ratios"]["0_W"] > 0
+    hist = last["param_histograms"]["0_W"]
+    assert sum(hist["counts"]) == 4 * 8
+    assert last["memory_rss_mb"] > 0
+
+
+def test_stats_listener_throughput_and_storage_events():
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_listener(lambda e: events.append(e.event_type))
+    listener = StatsListener(storage, update_frequency=1)
+    net = _net()
+    net.add_listener(listener)
+    net.fit(_data(), epochs=3)
+    updates = storage.get_all_updates(listener.session_id, TYPE_ID,
+                                      "worker_0")
+    assert len(updates) == 3
+    # 2nd+ reports carry throughput
+    assert "batches_per_sec" in updates[-1].data
+    assert "samples_per_sec" in updates[-1].data
+    assert "new_session" in events and "post_update" in events
+
+
+# ---------------------------------------------------------- TestStatsStorage
+
+@pytest.mark.parametrize("backend", ["memory", "file"])
+def test_storage_round_trip(backend, tmp_path):
+    if backend == "memory":
+        storage = InMemoryStatsStorage()
+    else:
+        storage = FileStatsStorage(str(tmp_path / "stats.db"))
+    rec_static = Persistable("s1", "T", "w0", 1.0, {"a": 1})
+    storage.put_static_info(rec_static)
+    for t in (2.0, 3.0, 4.0):
+        storage.put_update(Persistable("s1", "T", "w0", t, {"t": t}))
+    storage.put_update(Persistable("s2", "T", "w1", 9.0, {"t": 9.0}))
+
+    assert storage.list_session_ids() == ["s1", "s2"]
+    assert storage.list_type_ids("s1") == ["T"]
+    assert storage.list_worker_ids("s1") == ["w0"]
+    assert storage.get_static_info("s1", "T", "w0").data == {"a": 1}
+    ups = storage.get_all_updates("s1", "T", "w0")
+    assert [u.data["t"] for u in ups] == [2.0, 3.0, 4.0]
+    assert storage.get_latest_update("s1", "T", "w0").timestamp == 4.0
+    assert storage.get_all_updates_after("s1", "T", "w0", 2.5)[0].data[
+        "t"] == 3.0
+    assert storage.num_update_records("s1") == 3
+    storage.close()
+
+
+def test_file_storage_reopen(tmp_path):
+    path = str(tmp_path / "stats.db")
+    s1 = FileStatsStorage(path)
+    s1.put_static_info(Persistable("s", "T", "w", 1.0, {"x": 1}))
+    s1.put_update(Persistable("s", "T", "w", 2.0, {"y": 2}))
+    s1.close()
+    s2 = FileStatsStorage(path)     # the remote-dashboard reopen pattern
+    assert s2.list_session_ids() == ["s"]
+    assert s2.get_latest_update("s", "T", "w").data == {"y": 2}
+    s2.close()
+
+
+# --------------------------------------------------------------- TestPlayUI
+
+def test_ui_server_end_to_end():
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0).start()
+    try:
+        listener = StatsListener(storage, update_frequency=1)
+        net = _net()
+        net.add_listener(listener)
+        net.fit(_data(), epochs=4)
+
+        base = f"http://127.0.0.1:{server.port}"
+        page = urllib.request.urlopen(base + "/train/overview").read()
+        assert b"Training Dashboard" in page
+
+        sessions = json.loads(urllib.request.urlopen(
+            base + "/train/sessions").read())
+        assert sessions == [listener.session_id]
+
+        ov = json.loads(urllib.request.urlopen(
+            base + f"/train/overview/data?sid={listener.session_id}").read())
+        assert len(ov["score_vs_iter"]) == 4
+        assert ov["static"]["model_class"] == "MultiLayerNetwork"
+
+        md = json.loads(urllib.request.urlopen(
+            base + f"/train/model/data?sid={listener.session_id}").read())
+        assert "0_W" in md["params"]
+        assert md["params"]["0_W"]["histogram"] is not None
+        assert len(md["ratio_series"]["0_W"]) >= 2
+    finally:
+        server.stop()
+
+
+def test_remote_router_posts_into_server_storage():
+    """Training in one process, dashboard in another (reference
+    ``RemoteUIStatsStorageRouter`` + remote module): the listener posts via
+    HTTP and the records land in the server's storage."""
+    server = UIServer(port=0).start()
+    try:
+        router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+        listener = StatsListener(router, update_frequency=2)
+        net = _net()
+        net.add_listener(listener)
+        net.fit(_data(), epochs=4)
+        router.flush()               # posting is async (retry queue)
+
+        sid = listener.session_id
+        assert server.storage.list_session_ids() == [sid]
+        assert server.storage.get_static_info(sid, TYPE_ID,
+                                              "worker_0") is not None
+        assert server.storage.num_update_records(sid) == 2
+        ov = server.overview_data(sid)
+        assert len(ov["score_vs_iter"]) == 2
+    finally:
+        server.stop()
