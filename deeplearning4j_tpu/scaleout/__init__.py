@@ -1,0 +1,26 @@
+"""Cluster-training tier (reference layer 5, SURVEY.md §2.6b).
+
+TPU-native analogue of ``deeplearning4j-scaleout/spark/dl4j-spark``: the
+``TrainingMaster``/``TrainingWorker`` SPI, parameter-averaging master, the
+Export-style file-sharded data path, and multi-host (DCN) wiring.
+
+Design: Spark's driver/executor split maps to a coordinator + worker
+processes.  In tests the workers run in-process (the Spark ``local[N]``
+pattern, reference ``BaseSparkTest.java:45``); on a real pod the same
+master logic runs per host with ``jax.distributed`` and the aggregation
+rides DCN collectives instead of a Spark shuffle.
+"""
+
+from .api import NetBroadcastTuple, TrainingMaster, TrainingWorker
+from .data import (DataSetExportFunction, PathDataSetIterator,
+                   batch_and_export)
+from .frontend import ClusterComputationGraph, ClusterMultiLayer
+from .param_avg import (ParameterAveragingTrainingMaster,
+                        ParameterAveragingTrainingWorker)
+
+__all__ = [
+    "NetBroadcastTuple", "TrainingMaster", "TrainingWorker",
+    "DataSetExportFunction", "PathDataSetIterator", "batch_and_export",
+    "ClusterComputationGraph", "ClusterMultiLayer",
+    "ParameterAveragingTrainingMaster", "ParameterAveragingTrainingWorker",
+]

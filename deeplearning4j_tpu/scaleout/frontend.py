@@ -1,0 +1,50 @@
+"""Cluster-training frontends.
+
+TPU-native equivalent of the reference's
+``dl4j-spark/.../impl/multilayer/SparkDl4jMultiLayer.java``
+(``fit(JavaRDD<DataSet>):216``, ``fitPaths:260``, distributed
+``evaluate:516+``) and ``impl/graph/SparkComputationGraph.java``: thin
+user-facing wrappers binding a network to a :class:`TrainingMaster`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ..datasets.dataset import DataSet
+from .api import TrainingMaster
+
+
+class _ClusterFrontend:
+    def __init__(self, net, training_master: TrainingMaster):
+        self.net = net
+        self.training_master = training_master
+
+    def fit(self, data: Iterable[DataSet]):
+        """Train over a dataset collection (the RDD analogue)."""
+        self.training_master.execute_training(self.net, data)
+        return self.net
+
+    def fit_paths(self, paths: Sequence[str]):
+        """Train from exported minibatch files (reference ``fitPaths``)."""
+        self.training_master.execute_training_paths(self.net, paths)
+        return self.net
+
+    def evaluate(self, data: Iterable[DataSet]):
+        """Distributed-eval analogue: the master's model evaluates the
+        collection (reference ``SparkDl4jMultiLayer.evaluate``)."""
+        return self.net.evaluate(list(data))
+
+    def get_network(self):
+        return self.net
+
+    def get_score(self) -> float:
+        return float(self.net.score())
+
+
+class ClusterMultiLayer(_ClusterFrontend):
+    """``SparkDl4jMultiLayer`` analogue."""
+
+
+class ClusterComputationGraph(_ClusterFrontend):
+    """``SparkComputationGraph`` analogue."""

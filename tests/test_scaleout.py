@@ -1,0 +1,165 @@
+"""Cluster-training tier tests (reference dl4j-spark test patterns:
+``BaseSparkTest.java`` local[N] + ``TestSparkMultiLayerParameterAveraging``:
+training master produces a model equivalent to/as good as local fit,
+fitPaths works, worker results aggregate correctly)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout import (ClusterMultiLayer,
+                                         NetBroadcastTuple,
+                                         ParameterAveragingTrainingMaster,
+                                         ParameterAveragingTrainingWorker,
+                                         PathDataSetIterator,
+                                         batch_and_export)
+from deeplearning4j_tpu.scaleout.data import (DataSetExportFunction,
+                                              load_dataset)
+from deeplearning4j_tpu.scaleout.dcn import cross_host_mean, host_shard
+
+
+def _conf(updater="sgd", lr=0.5):
+    return (NeuralNetConfiguration.builder()
+            .seed(42).updater(updater).learning_rate(lr)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+
+
+def _batches(n_batches=16, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        X = rng.randn(batch, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+        out.append(DataSet(X, np.eye(3, dtype=np.float32)[y]))
+    return out
+
+
+# ------------------------------------------------------------ data path
+
+def test_export_and_path_iterator(tmp_path):
+    batches = _batches(4)
+    batches[0].features_mask = None
+    export = DataSetExportFunction(str(tmp_path))
+    paths = [export(ds) for ds in batches]
+    assert len(paths) == 4
+    loaded = load_dataset(paths[2])
+    np.testing.assert_array_equal(loaded.features, batches[2].features)
+    np.testing.assert_array_equal(loaded.labels, batches[2].labels)
+
+    it = PathDataSetIterator(paths)
+    assert it.batch() == 32
+    seen = list(it)
+    assert len(seen) == 4
+    # reset + re-iterate (DataSetIterator contract)
+    seen2 = list(it)
+    assert len(seen2) == 4
+
+
+def test_batch_and_export_rebatches(tmp_path):
+    # 6 batches of 32 re-batched to 48 -> 4 files
+    paths = batch_and_export(_batches(6), str(tmp_path), batch_size=48)
+    sizes = [load_dataset(p).num_examples() for p in paths]
+    assert sizes == [48, 48, 48, 48]
+
+
+# ------------------------------------------------------------ worker/broadcast
+
+def test_broadcast_round_trip_and_worker():
+    net = MultiLayerNetwork(_conf()).init()
+    net.fit(_batches(2)[0])          # move params + updater state off init
+    bcast = NetBroadcastTuple.from_model(net)
+    replica = bcast.build_model()
+    np.testing.assert_array_equal(replica.get_flat_params(),
+                                  net.get_flat_params())
+
+    worker = ParameterAveragingTrainingWorker()
+    worker.configure(bcast)
+    result = worker.process_partition(_batches(3, seed=1))
+    assert result.batches_processed == 3
+    assert np.isfinite(result.score)
+    # worker trained: params differ from broadcast
+    assert np.abs(result.params - bcast.params).max() > 0
+
+
+def test_single_worker_master_matches_local_fit():
+    """num_workers=1, avgFreq=n: the master must reproduce plain sequential
+    fit exactly (averaging over one worker is the identity)."""
+    batches = _batches(8)
+    local = MultiLayerNetwork(_conf()).init()
+    for ds in batches:
+        local.fit(ds)
+
+    clustered = MultiLayerNetwork(_conf()).init()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=1, batch_size_per_worker=32, averaging_frequency=8)
+    ClusterMultiLayer(clustered, master).fit(batches)
+    np.testing.assert_allclose(clustered.get_flat_params(),
+                               local.get_flat_params(), rtol=1e-6)
+
+
+def test_param_averaging_master_converges(tmp_path):
+    """4 workers, avgFreq 2, export data path: training must reach the same
+    quality as local fit (reference
+    TestSparkMultiLayerParameterAveraging.testAverageEveryStep*)."""
+    batches = _batches(32, seed=3)
+    clustered = MultiLayerNetwork(_conf(lr=0.3)).init()
+    master = ParameterAveragingTrainingMaster(
+        num_workers=4, batch_size_per_worker=32, averaging_frequency=2,
+        export_dir=str(tmp_path))
+    frontend = ClusterMultiLayer(clustered, master)
+    for _ in range(10):
+        frontend.fit(batches)
+    # split telemetry recorded (CommonSparkTrainingStats role)
+    assert len(master.stats) == 10 * 4    # 32 batches / (4 w * 2 freq)
+    ev = frontend.evaluate(_batches(4, seed=9))
+    assert ev.accuracy() > 0.8
+    assert clustered.iteration > 0
+
+
+def test_master_weighted_average_is_correct():
+    """Two workers with unequal partition sizes: the master's params must be
+    the batches-weighted average of worker results (ElementAddFunction
+    semantics)."""
+    net = MultiLayerNetwork(_conf()).init()
+    collected = []
+
+    class RecordingWorker(ParameterAveragingTrainingWorker):
+        def process_partition(self, partition):
+            r = super().process_partition(partition)
+            collected.append(r)
+            return r
+
+    master = ParameterAveragingTrainingMaster(
+        num_workers=2, averaging_frequency=2,
+        worker_factory=RecordingWorker)
+    # 3 batches -> partitions of 2 and 1 (round-robin)
+    master.execute_training(net, _batches(3))
+    w = np.array([r.batches_processed for r in collected], np.float64)
+    expect = sum(wi * r.params for wi, r in zip(w, collected)) / w.sum()
+    np.testing.assert_allclose(net.get_flat_params(), expect, rtol=1e-6)
+
+
+# ------------------------------------------------------------ dcn helpers
+
+def test_host_shard_partitions_paths():
+    paths = [f"p{i}" for i in range(10)]
+    s0 = host_shard(paths, process_id=0, process_count=3)
+    s1 = host_shard(paths, process_id=1, process_count=3)
+    s2 = host_shard(paths, process_id=2, process_count=3)
+    assert sorted(s0 + s1 + s2) == sorted(paths)
+    assert s0 == ["p0", "p3", "p6", "p9"]
+
+
+def test_cross_host_mean_single_process_identity():
+    flat = np.arange(5, dtype=np.float32)
+    np.testing.assert_array_equal(cross_host_mean(flat, weight=3.0), flat)
