@@ -1,0 +1,361 @@
+"""Keras 1.x import golden tests.
+
+The reference treats import goldens as a first-class test tier (SURVEY.md
+§4: ``deeplearning4j-modelimport/src/test/`` + the ``theano_mnist`` h5 +
+feature/label fixtures).  No original fixtures exist here, so each test
+WRITES a Keras-1-format .h5 in-test (h5py emits the same layout Keras 1
+produced: ``model_config`` attr + per-layer weight groups with
+``weight_names``) and checks the imported network's predictions against an
+independent numpy forward implementation of Keras semantics."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.keras_model_import import (
+    KerasModelImport, import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights)
+
+
+# ----------------------------------------------------------- fixture writer
+
+def _write_keras1_h5(path, model_config: dict, layer_weights: dict) -> None:
+    """Write a Keras-1-layout h5: f.attrs['model_config'] JSON + one group
+    per layer under /model_weights with attrs['weight_names']."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        g = f.create_group("model_weights")
+        for layer_name, weights in layer_weights.items():
+            lg = g.create_group(layer_name)
+            names = []
+            for wname, arr in weights.items():
+                full = f"{layer_name}_{wname}"
+                lg.create_dataset(full, data=np.asarray(arr, np.float32))
+                names.append(full.encode())
+            lg.attrs["weight_names"] = names
+
+
+def _seq_config(layers) -> dict:
+    return {"class_name": "Sequential", "config": layers}
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ------------------------------------------------------- sequential MLP
+
+def test_sequential_mlp_round_trip(tmp_path):
+    """Dense/Activation/Dropout/Dense-softmax sequential import matches a
+    numpy forward (reference KerasSequentialModel + theano_mnist golden
+    pattern)."""
+    r = _rng(1)
+    W1, b1 = r.randn(8, 16), r.randn(16)
+    W2, b2 = r.randn(16, 3), r.randn(3)
+    conf = _seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": 16,
+                    "activation": "tanh", "batch_input_shape": [None, 8]}},
+        {"class_name": "Dropout", "config": {"name": "dropout_1", "p": 0.5}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "output_dim": 3,
+                    "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "mlp.h5")
+    _write_keras1_h5(path, conf, {
+        "dense_1": {"W": W1, "b": b1},
+        "dense_2": {"W": W2, "b": b2},
+    })
+    net = import_keras_sequential_model_and_weights(path)
+
+    x = r.randn(5, 8).astype(np.float32)
+    h = np.tanh(x @ W1 + b1)              # dropout inactive at inference
+    logits = h @ W2 + b2
+    expect = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(net.output(x), expect, atol=1e-5)
+    # entry-point namespace parity
+    net2 = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(net2.output(x), expect, atol=1e-5)
+
+
+# ------------------------------------------------- conv th vs tf kernels
+
+@pytest.mark.parametrize("ordering", ["tf", "th"])
+def test_conv_dim_ordering(tmp_path, ordering):
+    """The same convolution expressed in th (NCHW kernels) and tf (HWIO)
+    layouts imports to identical predictions (reference
+    TensorFlowCnnToFeedForwardPreProcessor / KerasConvolution dim-ordering
+    handling)."""
+    r = _rng(2)
+    W_tf = r.randn(3, 3, 2, 4).astype(np.float32)      # HWIO
+    b = r.randn(4).astype(np.float32)
+    W = W_tf if ordering == "tf" else W_tf.transpose(3, 2, 0, 1)
+    shape = [None, 6, 6, 2] if ordering == "tf" else [None, 2, 6, 6]
+    conf = _seq_config([
+        {"class_name": "Convolution2D",
+         "config": {"name": "conv", "nb_filter": 4, "nb_row": 3,
+                    "nb_col": 3, "activation": "relu",
+                    "border_mode": "valid", "subsample": [1, 1],
+                    "dim_ordering": ordering,
+                    "batch_input_shape": shape}},
+        {"class_name": "Flatten", "config": {"name": "flat"}},
+        {"class_name": "Dense",
+         "config": {"name": "out", "output_dim": 2,
+                    "activation": "softmax"}},
+    ])
+    W2 = r.randn(4 * 4 * 4, 2).astype(np.float32)
+    b2 = r.randn(2).astype(np.float32)
+    path = str(tmp_path / f"conv_{ordering}.h5")
+    _write_keras1_h5(path, conf, {"conv": {"W": W, "b": b},
+                                  "out": {"W": W2, "b": b2}})
+    net = import_keras_sequential_model_and_weights(path)
+
+    x = r.randn(3, 6, 6, 2).astype(np.float32)         # our layout: NHWC
+    # numpy valid conv, NHWC x HWIO
+    out = np.zeros((3, 4, 4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            patch = x[:, i:i + 3, j:j + 3, :]
+            out[:, i, j, :] = np.tensordot(patch, W_tf,
+                                           axes=([1, 2, 3], [0, 1, 2]))
+    out = np.maximum(out + b, 0.0)
+    logits = out.reshape(3, -1) @ W2 + b2
+    expect = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = net.output(x.reshape(3, -1) if False else x)
+    np.testing.assert_allclose(got, expect, atol=1e-4)
+
+
+# ------------------------------------------------- LSTM gate-order remap
+
+def test_lstm_gate_order_remap(tmp_path):
+    """Keras per-gate [i,f,c,o] weights land in DL4J [c|f|o|i] fused layout
+    with zero peepholes (reference KerasLstm.java:150-230): imported
+    predictions must equal a from-scratch numpy Keras-1 LSTM."""
+    r = _rng(3)
+    I, H, T, B = 5, 7, 6, 4
+    gates = {}
+    for gate in ("i", "f", "c", "o"):
+        gates[f"W_{gate}"] = r.randn(I, H).astype(np.float32)
+        gates[f"U_{gate}"] = r.randn(H, H).astype(np.float32)
+        gates[f"b_{gate}"] = r.randn(H).astype(np.float32)
+    Wd = r.randn(H, 2).astype(np.float32)
+    bd = r.randn(2).astype(np.float32)
+    conf = _seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "lstm_1", "output_dim": H, "activation": "tanh",
+                    "inner_activation": "hard_sigmoid",
+                    "return_sequences": False,
+                    "batch_input_shape": [None, T, I]}},
+        {"class_name": "Dense",
+         "config": {"name": "out", "output_dim": 2,
+                    "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "lstm.h5")
+    _write_keras1_h5(path, conf, {"lstm_1": gates,
+                                  "out": {"W": Wd, "b": bd}})
+    net = import_keras_sequential_model_and_weights(path)
+
+    x = r.randn(B, T, I).astype(np.float32)
+
+    def hard_sigmoid(v):
+        return np.clip(0.2 * v + 0.5, 0.0, 1.0)
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        xt = x[:, t]
+        i = hard_sigmoid(xt @ gates["W_i"] + h @ gates["U_i"] + gates["b_i"])
+        f = hard_sigmoid(xt @ gates["W_f"] + h @ gates["U_f"] + gates["b_f"])
+        o = hard_sigmoid(xt @ gates["W_o"] + h @ gates["U_o"] + gates["b_o"])
+        cc = np.tanh(xt @ gates["W_c"] + h @ gates["U_c"] + gates["b_c"])
+        c = f * c + i * cc
+        h = o * np.tanh(c)
+    # Dense-after-RNN gets the auto-inserted RnnToFF preprocessor, so the
+    # net emits per-timestep outputs flattened to (B*T, 2); keras
+    # return_sequences=False corresponds to the last timestep's rows
+    seq_out = net.output(x).reshape(B, T, 2)
+    logits = h @ Wd + bd
+    expect = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(seq_out[:, -1], expect, atol=1e-4)
+
+
+# ------------------------------------------------- BatchNorm running stats
+
+def test_batchnorm_running_stats(tmp_path):
+    """Keras 1 stores variance in the 'running_std' slot; the importer must
+    land it in the inference variance (reference KerasBatchNormalization
+    mapping)."""
+    r = _rng(4)
+    gamma = r.rand(6).astype(np.float32) + 0.5
+    beta = r.randn(6).astype(np.float32)
+    mean = r.randn(6).astype(np.float32)
+    var = r.rand(6).astype(np.float32) + 0.2
+    W1, b1 = r.randn(4, 6).astype(np.float32), r.randn(6).astype(np.float32)
+    conf = _seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": 6,
+                    "activation": "linear",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "BatchNormalization",
+         "config": {"name": "bn_1", "mode": 0, "epsilon": 1e-5}},
+    ])
+    path = str(tmp_path / "bn.h5")
+    _write_keras1_h5(path, conf, {
+        "dense_1": {"W": W1, "b": b1},
+        "bn_1": {"gamma": gamma, "beta": beta, "running_mean": mean,
+                 "running_std": var},
+    })
+    net = import_keras_sequential_model_and_weights(path)
+    x = r.randn(3, 4).astype(np.float32)
+    pre = x @ W1 + b1
+    expect = gamma * (pre - mean) / np.sqrt(var + 1e-5) + beta
+    np.testing.assert_allclose(net.output(x), expect, atol=1e-4)
+
+
+# ------------------------------------------------- functional API + Merge
+
+def test_functional_model_with_merge(tmp_path):
+    """Two-branch functional model merged by concat -> ComputationGraph
+    (reference KerasModel.java:59 getComputationGraphConfiguration)."""
+    r = _rng(5)
+    Wa, ba = r.randn(4, 8).astype(np.float32), r.randn(8).astype(np.float32)
+    Wb, bb = r.randn(4, 8).astype(np.float32), r.randn(8).astype(np.float32)
+    Wo, bo = r.randn(16, 3).astype(np.float32), r.randn(3).astype(np.float32)
+    conf = {
+        "class_name": "Model",
+        "config": {
+            "name": "model_1",
+            "layers": [
+                {"class_name": "InputLayer", "name": "input_1",
+                 "config": {"name": "input_1",
+                            "batch_input_shape": [None, 4]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "branch_a",
+                 "config": {"name": "branch_a", "output_dim": 8,
+                            "activation": "relu"},
+                 "inbound_nodes": [[["input_1", 0, 0]]]},
+                {"class_name": "Dense", "name": "branch_b",
+                 "config": {"name": "branch_b", "output_dim": 8,
+                            "activation": "tanh"},
+                 "inbound_nodes": [[["input_1", 0, 0]]]},
+                {"class_name": "Merge", "name": "merge_1",
+                 "config": {"name": "merge_1", "mode": "concat"},
+                 "inbound_nodes": [[["branch_a", 0, 0],
+                                    ["branch_b", 0, 0]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "output_dim": 3,
+                            "activation": "softmax"},
+                 "inbound_nodes": [[["merge_1", 0, 0]]]},
+            ],
+            "input_layers": [["input_1", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    }
+    path = str(tmp_path / "func.h5")
+    _write_keras1_h5(path, conf, {
+        "branch_a": {"W": Wa, "b": ba},
+        "branch_b": {"W": Wb, "b": bb},
+        "out": {"W": Wo, "b": bo},
+    })
+    cg = import_keras_model_and_weights(path)
+    x = r.randn(6, 4).astype(np.float32)
+    merged = np.concatenate([np.maximum(x @ Wa + ba, 0),
+                             np.tanh(x @ Wb + bb)], axis=1)
+    logits = merged @ Wo + bo
+    expect = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = cg.output(x)          # single-output graph -> one array
+    np.testing.assert_allclose(got, expect, atol=1e-4)
+
+
+# ------------------------------------------------- imported model trains
+
+def test_imported_model_is_trainable(tmp_path):
+    """Import then fit: the reference's import path produces fully
+    trainable networks, not inference-only shells."""
+    r = _rng(6)
+    conf = _seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "d1", "output_dim": 16, "activation": "tanh",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "output_dim": 3,
+                    "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "train.h5")
+    _write_keras1_h5(path, conf, {
+        "d1": {"W": r.randn(4, 16), "b": np.zeros(16)},
+        "d2": {"W": r.randn(16, 3), "b": np.zeros(3)},
+    })
+    net = import_keras_sequential_model_and_weights(path)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    X = r.randn(64, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+    ds = DataSet(X, y)
+    s0 = net.score(ds)
+    net.fit(ds, epochs=30)
+    assert net.score(ds) < s0 * 0.7
+
+
+# ------------------------------------------------- VGG16 / TrainedModels
+
+def test_vgg16_architecture_builds():
+    """BASELINE config #5 architecture: VGG-16 builds with the canonical
+    138M params (reference TrainedModels.VGG16)."""
+    from deeplearning4j_tpu.keras.trained_models import vgg16
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(vgg16()).init()
+    assert net.num_params() == 138_357_544
+    # 13 convs + 5 pools + 2 dense + 1 output
+    assert len(net.conf.layers) == 21
+
+
+def test_vgg16_image_preprocessor():
+    from deeplearning4j_tpu.keras.trained_models import VGG16ImagePreProcessor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    pre = VGG16ImagePreProcessor()
+    img = np.full((2, 4, 4, 3), 128.0, np.float32)
+    out = pre.transform(img)
+    np.testing.assert_allclose(out[0, 0, 0],
+                               128.0 - np.array([123.68, 116.779, 103.939]),
+                               atol=1e-4)
+    ds = DataSet(img, np.zeros((2, 10), np.float32))
+    pre.preprocess(ds)
+    np.testing.assert_allclose(ds.features, out, atol=1e-6)
+
+
+def test_vgg16_weight_loading(tmp_path):
+    """load_vgg16 reads Keras-1-layout h5 weights into the right layers
+    (smoke on a tiny 32x32 variant to keep the test fast)."""
+    from deeplearning4j_tpu.keras.trained_models import load_vgg16, vgg16
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    # write weights matching the *real* architecture's first conv only is
+    # not enough: build the net, dump its params into an h5 in keras-1
+    # layout, reload, and require bit-identical params.
+    net = MultiLayerNetwork(vgg16(n_classes=7, height=32, width=32)).init()
+    path = str(tmp_path / "vgg.h5")
+    with h5py.File(path, "w") as f:
+        g = f.create_group("model_weights")
+        n = 0
+        for i, layer in enumerate(net.conf.layers):
+            if not net.params[i]:
+                continue
+            lg = g.create_group(f"layer_{n:02d}")
+            wn = [f"layer_{n:02d}_W".encode(), f"layer_{n:02d}_b".encode()]
+            lg.create_dataset(wn[0].decode(),
+                              data=np.asarray(net.params[i]["W"]))
+            lg.create_dataset(wn[1].decode(),
+                              data=np.asarray(net.params[i]["b"]))
+            lg.attrs["weight_names"] = wn
+            n += 1
+    # load via the public loader, sized to the small test architecture
+    import unittest.mock as mock
+
+    import deeplearning4j_tpu.keras.trained_models as tm
+    with mock.patch.object(tm, "vgg16",
+                           lambda **kw: vgg16(n_classes=7, height=32,
+                                              width=32)):
+        net3 = tm.load_vgg16(path, n_classes=7)
+    np.testing.assert_array_equal(net3.get_flat_params(),
+                                  net.get_flat_params())
