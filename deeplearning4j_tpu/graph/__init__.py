@@ -1,0 +1,24 @@
+"""Graph embeddings tier: graphs, random walks, DeepWalk.
+
+Reference module: ``deeplearning4j-graph/`` (``graph/Graph.java``,
+``iterator/RandomWalkIterator.java``, ``models/deepwalk/DeepWalk.java``,
+``models/embeddings/GraphVectorsImpl.java``).  Walks are generated
+vectorised over all walkers; training batches pairs through the word2vec
+tier's XLA hierarchical-softmax kernel.
+"""
+
+from .api import (Edge, NoEdgeHandling, NoEdgesException, Vertex,
+                  VertexSequence)
+from .deepwalk import (DeepWalk, GraphHuffman, GraphVectors,
+                       load_txt_vectors, write_graph_vectors)
+from .graph import Graph, GraphLoader
+from .iterators import (RandomWalkGraphIteratorProvider, RandomWalkIterator,
+                        WeightedRandomWalkIterator, generate_walks)
+
+__all__ = [
+    "Edge", "NoEdgeHandling", "NoEdgesException", "Vertex",
+    "VertexSequence", "Graph", "GraphLoader", "RandomWalkIterator",
+    "WeightedRandomWalkIterator", "RandomWalkGraphIteratorProvider",
+    "generate_walks", "DeepWalk", "GraphHuffman", "GraphVectors",
+    "write_graph_vectors", "load_txt_vectors",
+]

@@ -1,0 +1,307 @@
+"""DeepWalk graph embeddings + GraphVectors API.
+
+Reference: ``deeplearning4j-graph/.../models/deepwalk/DeepWalk.java:31``
+(random-walk skip-gram over vertices, hierarchical softmax over a
+degree-frequency Huffman tree), ``deepwalk/GraphHuffman.java`` (tree over
+vertex degrees), ``models/embeddings/InMemoryGraphLookupTable.java``
+(vertex vectors + inner-node weights, per-pair ``iterate``),
+``models/embeddings/GraphVectorsImpl.java`` (similarity /
+verticesNearest), ``models/loader/GraphVectorSerializer.java`` (text
+save/load).
+
+TPU-first redesign: the reference trains one (vertex, vertex) pair per
+``iterate`` call on the host.  Here walks are generated vectorised
+(``iterators.generate_walks``), window pairs are extracted for the whole
+walk batch with numpy slicing, and updates run through the same batched
+XLA hierarchical-softmax scatter-add kernel the word2vec tier uses
+(``nlp.word2vec._hs_step``) — thousands of pairs per device dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..nlp.vocab import huffman_codes
+from ..nlp.word2vec import _hs_step
+from .api import NoEdgeHandling
+from .graph import Graph
+from .iterators import RandomWalkIterator, generate_walks
+
+
+class GraphHuffman:
+    """Huffman tree over vertex degrees for hierarchical softmax
+    (reference ``deepwalk/GraphHuffman.java`` — codes + path inner nodes
+    per vertex).  Same bottom-up two-pointer construction as the word2vec
+    tier (``nlp/vocab.py:build_huffman_tree``), generalised to raw
+    frequencies."""
+
+    def __init__(self, frequencies: Sequence[int],
+                 max_code_length: int = 64):
+        freqs = [max(int(f), 1) for f in frequencies]
+        n = len(freqs)
+        if n < 2:
+            raise ValueError("need at least 2 vertices for a Huffman tree")
+        assigned = huffman_codes(freqs, max_code_length)
+        self._codes: List[List[int]] = [c for c, _ in assigned]
+        self._points: List[List[int]] = [p for _, p in assigned]
+        self.num_inner = n - 1
+
+    def get_code(self, vertex: int) -> List[int]:
+        return list(self._codes[vertex])
+
+    def get_code_length(self, vertex: int) -> int:
+        return len(self._codes[vertex])
+
+    def get_path_inner_nodes(self, vertex: int) -> List[int]:
+        return list(self._points[vertex])
+
+
+class GraphVectors:
+    """Learned vertex representations (reference
+    ``models/GraphVectors.java`` / ``GraphVectorsImpl.java``)."""
+
+    def __init__(self, graph: Optional[Graph], vectors: np.ndarray):
+        self.graph = graph
+        self._vectors = np.asarray(vectors, dtype=np.float32)
+
+    def num_vertices(self) -> int:
+        return self._vectors.shape[0]
+
+    @property
+    def vector_size(self) -> int:
+        return self._vectors.shape[1]
+
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return self._vectors[idx].copy()
+
+    def vertex_vectors(self) -> np.ndarray:
+        return self._vectors
+
+    def similarity(self, v1: int, v2: int) -> float:
+        """Cosine similarity (reference ``GraphVectorsImpl.similarity``)."""
+        a, b = self._vectors[v1], self._vectors[v2]
+        denom = float(np.linalg.norm(a) * np.linalg.norm(b))
+        return float(np.dot(a, b) / denom) if denom > 0 else 0.0
+
+    def vertices_nearest(self, vertex_idx: int, top: int) -> np.ndarray:
+        """Top-N vertices by cosine similarity, excluding the query vertex
+        (reference ``GraphVectorsImpl.verticesNearest`` — priority queue
+        there; one vectorised matmul + argpartition here)."""
+        v = self._vectors[vertex_idx]
+        norms = np.linalg.norm(self._vectors, axis=1) * np.linalg.norm(v)
+        sims = (self._vectors @ v) / np.maximum(norms, 1e-12)
+        sims[vertex_idx] = -np.inf
+        top = min(top, sims.size - 1)
+        idx = np.argpartition(-sims, top - 1)[:top]
+        return idx[np.argsort(-sims[idx])]
+
+
+class DeepWalk(GraphVectors):
+    """DeepWalk (Perozzi et al. 2014) — skip-gram with hierarchical softmax
+    over random vertex walks (reference ``deepwalk/DeepWalk.java``).
+
+    Usage matches the reference: ``Builder`` → ``initialize(graph)`` (or a
+    degree list) → ``fit(graph, walk_length)``.
+    """
+
+    def __init__(self, vector_size: int = 100, window_size: int = 2,
+                 learning_rate: float = 0.01, seed: Optional[int] = 0,
+                 batch_size: int = 2048):
+        self.vector_size_cfg = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.batch_size = batch_size
+        self._init_called = False
+        self.huffman: Optional[GraphHuffman] = None
+        self.syn0: Optional[jnp.ndarray] = None
+        self.syn1: Optional[jnp.ndarray] = None
+        self.graph = None
+        self._cum_loss = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, graph_or_degrees) -> None:
+        """Build the degree-Huffman tree and init weights (reference
+        ``DeepWalk.initialize`` — vectors ~ (U(0,1)-0.5)/vectorSize)."""
+        if isinstance(graph_or_degrees, Graph):
+            self.graph = graph_or_degrees
+            degrees = graph_or_degrees.degrees()
+        else:
+            degrees = np.asarray(graph_or_degrees, dtype=np.int64)
+        n = int(degrees.size)
+        self.huffman = GraphHuffman(degrees.tolist())
+        rng = np.random.default_rng(self.seed)
+        d = self.vector_size_cfg
+        self.syn0 = jnp.asarray(
+            (rng.random((n, d)) - 0.5) / d, dtype=jnp.float32)
+        self.syn1 = jnp.asarray(
+            (rng.random((self.huffman.num_inner, d)) - 0.5) / d,
+            dtype=jnp.float32)
+        max_len = max(self.huffman.get_code_length(v) for v in range(n))
+        self._points = np.zeros((n, max_len), dtype=np.int32)
+        self._codes = np.zeros((n, max_len), dtype=np.float32)
+        self._code_mask = np.zeros((n, max_len), dtype=np.float32)
+        for v in range(n):
+            pts = self.huffman.get_path_inner_nodes(v)
+            cds = self.huffman.get_code(v)
+            self._points[v, :len(pts)] = pts
+            self._codes[v, :len(cds)] = cds
+            self._code_mask[v, :len(cds)] = 1.0
+        self._init_called = True
+
+    # -- training ----------------------------------------------------------
+
+    def fit(self, graph: Optional[Graph] = None, walk_length: int = 40,
+            iterator: Optional[RandomWalkIterator] = None,
+            epochs: int = 1) -> "DeepWalk":
+        """Fit from a graph (fresh uniform walks per epoch, reference
+        ``DeepWalk.fit(IGraph,int)``) or from a supplied walk iterator
+        (reference ``fit(GraphWalkIterator)``)."""
+        if not self._init_called:
+            if graph is None and iterator is not None:
+                graph = iterator.graph
+            if graph is None:
+                raise RuntimeError("DeepWalk not initialized: call "
+                                   "initialize(graph) or pass a graph")
+            self.initialize(graph)
+        if graph is not None:
+            self.graph = graph
+        rng = np.random.default_rng(self.seed)
+        for _ in range(epochs):
+            if iterator is not None:
+                walks = iterator.walks_array()
+                iterator.reset()
+            else:
+                starts = np.arange(self.graph.num_vertices())
+                rng.shuffle(starts)
+                walks = generate_walks(
+                    self.graph, walk_length, rng, start_vertices=starts,
+                    no_edge=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+            self._train_walks(walks)
+        return self
+
+    def _walk_pairs(self, walks: np.ndarray) -> Tuple[np.ndarray,
+                                                      np.ndarray]:
+        """(input, target) pairs under the reference window rule
+        (``DeepWalk.skipGram`` — mid ranges over
+        ``[windowSize, len-windowSize)``, pos over ±window, pos != mid) —
+        extracted for the whole walk batch at once by shifted slicing."""
+        w = self.window_size
+        L = walks.shape[1]
+        ins, tgts = [], []
+        for mid in range(w, L - w):
+            for off in range(-w, w + 1):
+                if off == 0:
+                    continue
+                ins.append(walks[:, mid])
+                tgts.append(walks[:, mid + off])
+        if not ins:
+            return (np.empty(0, np.int64),) * 2
+        return np.concatenate(ins), np.concatenate(tgts)
+
+    def _train_walks(self, walks: np.ndarray) -> None:
+        inputs, targets = self._walk_pairs(walks)
+        if inputs.size == 0:
+            return
+        B = self.batch_size
+        lr = jnp.float32(self.learning_rate)
+        for s in range(0, inputs.size, B):
+            bi = inputs[s:s + B]
+            bt = targets[s:s + B]
+            pad = B - bi.size
+            pair_mask = np.ones(B, np.float32)
+            if pad:
+                pair_mask[bi.size:] = 0.0
+                bi = np.pad(bi, (0, pad))
+                bt = np.pad(bt, (0, pad))
+            self.syn0, self.syn1, loss = _hs_step(
+                self.syn0, self.syn1,
+                jnp.asarray(bi, jnp.int32),
+                jnp.asarray(self._points[bt]),
+                jnp.asarray(self._codes[bt]),
+                jnp.asarray(self._code_mask[bt]),
+                jnp.asarray(pair_mask), lr)
+            self._cum_loss += float(loss)
+
+    # -- GraphVectors surface ---------------------------------------------
+
+    @property
+    def _vectors(self) -> np.ndarray:
+        if self.syn0 is None:
+            raise RuntimeError("DeepWalk not initialized")
+        return np.asarray(self.syn0)
+
+    @_vectors.setter
+    def _vectors(self, value) -> None:  # GraphVectors.__init__ compat
+        self.syn0 = jnp.asarray(value)
+
+    def get_vector_size(self) -> int:
+        return self.vector_size_cfg
+
+    class Builder:
+        """Reference ``DeepWalk.Builder`` surface."""
+
+        def __init__(self):
+            self._vector_size = 100
+            self._window_size = 2
+            self._learning_rate = 0.01
+            self._seed: Optional[int] = 0
+            self._batch_size = 2048
+
+        def vector_size(self, v: int) -> "DeepWalk.Builder":
+            self._vector_size = v
+            return self
+
+        def window_size(self, w: int) -> "DeepWalk.Builder":
+            self._window_size = w
+            return self
+
+        def learning_rate(self, lr: float) -> "DeepWalk.Builder":
+            self._learning_rate = lr
+            return self
+
+        def seed(self, s: int) -> "DeepWalk.Builder":
+            self._seed = s
+            return self
+
+        def batch_size(self, b: int) -> "DeepWalk.Builder":
+            self._batch_size = b
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(self._vector_size, self._window_size,
+                            self._learning_rate, self._seed,
+                            self._batch_size)
+
+
+def write_graph_vectors(model: GraphVectors, path: str) -> None:
+    """Text save: one line per vertex, ``id<TAB>v0<TAB>v1...`` (reference
+    ``models/loader/GraphVectorSerializer.writeGraphVectors``)."""
+    vecs = model.vertex_vectors()
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(vecs.shape[0]):
+            f.write("\t".join([str(i)] + [repr(float(x))
+                                          for x in vecs[i]]) + "\n")
+
+
+def load_txt_vectors(path: str) -> GraphVectors:
+    """Load vectors written by :func:`write_graph_vectors` (reference
+    ``GraphVectorSerializer.loadTxtVectors``)."""
+    rows = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 2:
+                continue
+            rows[int(parts[0])] = [float(x) for x in parts[1:]]
+    n = max(rows) + 1
+    dim = len(next(iter(rows.values())))
+    vecs = np.zeros((n, dim), dtype=np.float32)
+    for i, v in rows.items():
+        vecs[i] = v
+    return GraphVectors(None, vecs)

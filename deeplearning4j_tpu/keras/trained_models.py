@@ -91,8 +91,15 @@ def load_vgg16(weights_path: Optional[str] = None,
         # layers with params, in order
         param_layers = [i for i, l in enumerate(net.conf.layers)
                         if net.params[i]]
+        # Keras-1 save_weights records file order in the `layer_names`
+        # root attr; h5py group iteration is alphabetical (conv2d_10
+        # sorts before conv2d_2), so the attr is authoritative.
+        layer_names = [n.decode() if isinstance(n, bytes) else str(n)
+                       for n in g.attrs.get("layer_names", [])]
+        if not layer_names:
+            layer_names = list(g)
         h5_layers = []
-        for name in g:
+        for name in layer_names:
             grp = g[name]
             names = list(grp.attrs.get("weight_names", []))
             if names:
@@ -101,17 +108,33 @@ def load_vgg16(weights_path: Optional[str] = None,
             raise ValueError(
                 f"VGG16 weight file has {len(h5_layers)} param layers, "
                 f"architecture expects {len(param_layers)}")
+        th_detected = False
+        last_conv_channels = None
+        seen_dense_after_conv = False
         for (name, grp, names), i in zip(h5_layers, param_layers):
             arrays = [np.asarray(grp[n if isinstance(n, str)
                                      else n.decode()]) for n in names]
             W, bias = arrays[0], arrays[1]
-            if W.ndim == 4 and W.shape[0] not in (1, 3):
-                # th ordering (nb_filter, stack, kh, kw) -> HWIO
-                if W.shape[-1] != net.params[i]["W"].shape[-1]:
+            want = net.params[i]["W"].shape
+            if W.ndim == 4:
+                last_conv_channels = want[-1]
+                if W.shape[0] not in (1, 3) and W.shape[-1] != want[-1]:
+                    # th ordering (nb_filter, stack, kh, kw) -> HWIO
                     W = W.transpose(2, 3, 1, 0)
+                    th_detected = True
+            elif (W.ndim == 2 and not seen_dense_after_conv
+                  and last_conv_channels is not None):
+                seen_dense_after_conv = True
+                if th_detected:
+                    # th flatten order is (C, H, W); this network flattens
+                    # NHWC — permute the first dense layer's input rows.
+                    c = last_conv_channels
+                    s = int(round((W.shape[0] / c) ** 0.5))
+                    W = (W.reshape(c, s, s, W.shape[1])
+                          .transpose(1, 2, 0, 3)
+                          .reshape(W.shape[0], W.shape[1]))
             net.params[i]["W"] = jnp.asarray(
-                W.reshape(net.params[i]["W"].shape),
-                net.params[i]["W"].dtype)
+                W.reshape(want), net.params[i]["W"].dtype)
             net.params[i]["b"] = jnp.asarray(
                 bias.reshape(net.params[i]["b"].shape),
                 net.params[i]["b"].dtype)

@@ -136,21 +136,33 @@ class VocabConstructor:
         return cache
 
 
-def build_huffman_tree(cache: VocabCache, max_code_length: int = 40) -> None:
-    """Assign Huffman codes/points to every vocab word (reference
-    ``models/word2vec/Huffman.java``).
+def huffman_codes(frequencies: Sequence[int], max_code_length: int = 40):
+    """Huffman codes + syn1 point paths for arbitrary frequencies
+    (reference ``models/word2vec/Huffman.java``; also backs the graph
+    tier's degree tree, ``deepwalk/GraphHuffman.java``).
 
     Standard word2vec construction: two frequency-sorted arrays merged
-    bottom-up; each word's ``codes`` are its binary branch decisions from
-    root to leaf, ``points`` the inner-node indices along that path (offsets
-    into syn1).
+    bottom-up; each element's ``codes`` are its binary branch decisions
+    from root to leaf, ``points`` the inner-node indices along that path
+    (offsets into syn1: root = n-2, then top-down, leaf excluded).
+
+    Input order is arbitrary — a stable descending-frequency permutation is
+    applied internally and inverted on output.  Returns a list of
+    ``(codes, points)`` pairs, one per input index.
     """
-    words = cache.vocab_words()
-    n = len(words)
+    freqs = [int(f) for f in frequencies]
+    n = len(freqs)
     if n == 0:
-        return
-    # count array: leaves then inner nodes (classic word2vec layout)
-    count = [int(w.element_frequency) for w in words] + [int(1e15)] * (n - 1)
+        return []
+    if n == 1:
+        return [([], [])]
+    # classic word2vec layout expects leaves sorted descending by freq
+    perm = sorted(range(n), key=lambda i: -freqs[i])
+    inv = [0] * n
+    for sorted_pos, orig in enumerate(perm):
+        inv[orig] = sorted_pos
+    # count array: leaves then inner nodes
+    count = [freqs[perm[i]] for i in range(n)] + [int(1e15)] * (n - 1)
     binary = [0] * (2 * n - 1)
     parent = [0] * (2 * n - 1)
     pos1, pos2 = n - 1, n
@@ -168,10 +180,11 @@ def build_huffman_tree(cache: VocabCache, max_code_length: int = 40) -> None:
         parent[min1] = n + i
         parent[min2] = n + i
         binary[min2] = 1
-    for i, w in enumerate(words):
+    out = []
+    for orig in range(n):
         codes: List[int] = []
         points: List[int] = []
-        node = i
+        node = inv[orig]
         while node != 2 * n - 2:
             codes.append(binary[node])
             points.append(node)
@@ -183,6 +196,21 @@ def build_huffman_tree(cache: VocabCache, max_code_length: int = 40) -> None:
         # reference Huffman.java) are the root (inner-node id n-2) followed
         # by the path inner nodes top-down, excluding the leaf; inner-node
         # ids shift down by n (the leaf count).
-        w.codes = codes[:max_code_length]
-        w.points = ([n - 2] + [p - n for p in points[:-1]])[:len(w.codes)]
+        codes = codes[:max_code_length]
+        out.append((codes,
+                    ([n - 2] + [p - n for p in points[:-1]])[:len(codes)]))
+    return out
+
+
+def build_huffman_tree(cache: VocabCache, max_code_length: int = 40) -> None:
+    """Assign Huffman codes/points to every vocab word (reference
+    ``models/word2vec/Huffman.java``) via :func:`huffman_codes`."""
+    words = cache.vocab_words()
+    if not words:
+        return
+    assigned = huffman_codes([int(w.element_frequency) for w in words],
+                             max_code_length)
+    for w, (codes, points) in zip(words, assigned):
+        w.codes = codes
+        w.points = points
     cache.huffman_built = True

@@ -123,7 +123,7 @@ def test_conv_dim_ordering(tmp_path, ordering):
     out = np.maximum(out + b, 0.0)
     logits = out.reshape(3, -1) @ W2 + b2
     expect = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
-    got = net.output(x.reshape(3, -1) if False else x)
+    got = net.output(x)
     np.testing.assert_allclose(got, expect, atol=1e-4)
 
 
