@@ -1,0 +1,35 @@
+"""Data tier: DataSet containers, canned datasets, record-reader ETL,
+normalizers, async prefetch iterators.
+
+Reference modules: ``deeplearning4j-core/src/main/java/org/deeplearning4j/
+datasets/`` (fetchers + iterator impls + the DataVec bridge) and the ND4J
+DataSet/normalizer API surface (SURVEY.md §2.2, §2.10).
+"""
+
+from .cifar import CifarDataSetIterator, cifar_arrays
+from .dataset import DataSet, MultiDataSet
+from .iris import IrisDataSetIterator
+from .iterators import (AsyncDataSetIterator, DataSetIterator,
+                        ExistingDataSetIterator, ListDataSetIterator,
+                        MultipleEpochsIterator)
+from .mnist import MnistDataSetIterator, mnist_arrays
+from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
+                          NormalizerStandardize, load_normalizer)
+from .records import (AlignmentMode, CollectionRecordReader,
+                      CollectionSequenceRecordReader, CSVRecordReader,
+                      CSVSequenceRecordReader, RecordReader,
+                      RecordReaderDataSetIterator, SequenceRecordReader,
+                      SequenceRecordReaderDataSetIterator)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ExistingDataSetIterator", "MultipleEpochsIterator",
+    "AsyncDataSetIterator", "MnistDataSetIterator", "mnist_arrays",
+    "IrisDataSetIterator", "CifarDataSetIterator", "cifar_arrays",
+    "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "load_normalizer", "RecordReader",
+    "CollectionRecordReader", "CSVRecordReader", "SequenceRecordReader",
+    "CollectionSequenceRecordReader", "CSVSequenceRecordReader",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "AlignmentMode",
+]

@@ -26,7 +26,22 @@ from .dataset import DataSet
 
 
 class DataSetIterator:
-    """Base contract (reference ``DataSetIterator``)."""
+    """Base contract (reference ``DataSetIterator``, incl. its
+    ``setPreProcessor`` — a ``DataSetPreProcessor`` applied to every batch
+    the iterator emits)."""
+
+    _preprocessor = None
+
+    def set_preprocessor(self, preprocessor) -> None:
+        self._preprocessor = preprocessor
+
+    def get_preprocessor(self):
+        return self._preprocessor
+
+    def _pre(self, ds: DataSet) -> DataSet:
+        if self._preprocessor is not None:
+            self._preprocessor.preprocess(ds)
+        return ds
 
     def reset(self) -> None:
         raise NotImplementedError
@@ -79,7 +94,7 @@ class ListDataSetIterator(DataSetIterator):
         def _take(a):
             return None if a is None else np.asarray(a)[idx]
 
-        return DataSet(*[_take(a) for a in self._ds.as_tuple()])
+        return self._pre(DataSet(*[_take(a) for a in self._ds.as_tuple()]))
 
 
 class ExistingDataSetIterator(DataSetIterator):
@@ -99,7 +114,7 @@ class ExistingDataSetIterator(DataSetIterator):
     def __next__(self) -> DataSet:
         if self._it is None:
             self.reset()
-        return next(self._it)
+        return self._pre(next(self._it))
 
 
 class MultipleEpochsIterator(DataSetIterator):
@@ -120,13 +135,13 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def __next__(self) -> DataSet:
         try:
-            return next(self._under)
+            return self._pre(next(self._under))
         except StopIteration:
             self._epoch += 1
             if self._epoch >= self._epochs:
                 raise
             self._under.reset()
-            return next(self._under)
+            return self._pre(next(self._under))
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -180,4 +195,4 @@ class AsyncDataSetIterator(DataSetIterator):
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        return item
+        return self._pre(item)

@@ -1,0 +1,311 @@
+"""Record readers + the record→DataSet ETL bridge.
+
+TPU-native equivalent of the DataVec bridge the reference trains from:
+``datasets/datavec/RecordReaderDataSetIterator.java`` (records → feature
+matrix + one-hot/regression labels) and
+``datasets/datavec/SequenceRecordReaderDataSetIterator.java`` (paired
+feature/label sequence readers, EQUAL_LENGTH / ALIGN_START / ALIGN_END
+alignment with masks), plus the minimal reader SPI they consume
+(DataVec's ``CSVRecordReader`` / ``CSVSequenceRecordReader`` /
+``CollectionRecordReader``).
+
+Host-side ETL; batches come out as numpy DataSets ready to donate into the
+jitted train step.  Whole-batch assembly is vectorised (one ``np.asarray``
+per batch, not per record).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import DataSetIterator
+
+Record = List[Union[float, int, str]]
+
+
+# ------------------------------------------------------------------ readers
+
+class RecordReader:
+    """Minimal reader SPI (DataVec ``RecordReader``): a resettable stream
+    of records, each a list of values."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_record(self) -> Record:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (DataVec ``CollectionRecordReader``)."""
+
+    def __init__(self, records: Sequence[Record]):
+        self._records = [list(r) for r in records]
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._records)
+
+    def next_record(self) -> Record:
+        r = self._records[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class CSVRecordReader(RecordReader):
+    """CSV line reader (DataVec ``CSVRecordReader``): ``initialize(path)``
+    then stream one record per line, with ``skip_num_lines`` header skip."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip_num_lines = skip_num_lines
+        self.delimiter = delimiter
+        self._records: List[Record] = []
+        self._pos = 0
+
+    def initialize(self, path: str) -> "CSVRecordReader":
+        with open(path, "r", encoding="utf-8") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        self._records = [ln.split(self.delimiter)
+                         for ln in lines[self.skip_num_lines:] if ln]
+        self._pos = 0
+        return self
+
+    has_next = CollectionRecordReader.has_next
+    next_record = CollectionRecordReader.next_record
+    reset = CollectionRecordReader.reset
+
+
+class SequenceRecordReader:
+    """Sequence reader SPI (DataVec ``SequenceRecordReader``): a stream of
+    sequences, each a list of records (time steps)."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_sequence(self) -> List[Record]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """In-memory sequences (DataVec ``CollectionSequenceRecordReader``)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Record]]):
+        self._seqs = [[list(r) for r in s] for s in sequences]
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._seqs)
+
+    def next_sequence(self) -> List[Record]:
+        s = self._seqs[self._pos]
+        self._pos += 1
+        return [list(r) for r in s]
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class CSVSequenceRecordReader(CollectionSequenceRecordReader):
+    """One CSV file per sequence (DataVec ``CSVSequenceRecordReader``);
+    ``initialize`` takes a list of file paths or a directory."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        super().__init__([])
+        self.skip_num_lines = skip_num_lines
+        self.delimiter = delimiter
+
+    def initialize(self, paths: Union[str, Sequence[str]]
+                   ) -> "CSVSequenceRecordReader":
+        if isinstance(paths, str):
+            paths = sorted(
+                os.path.join(paths, n) for n in os.listdir(paths)
+                if not n.startswith("."))
+        seqs = []
+        for p in paths:
+            with open(p, "r", encoding="utf-8") as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            seqs.append([ln.split(self.delimiter)
+                         for ln in lines[self.skip_num_lines:] if ln])
+        self._seqs = seqs
+        self._pos = 0
+        return self
+
+
+# ------------------------------------------------------- records → DataSet
+
+def _one_hot(values: np.ndarray, num_classes: int) -> np.ndarray:
+    idx = values.astype(np.int64)
+    if (idx < 0).any() or (idx >= num_classes).any():
+        raise ValueError(f"label out of range [0,{num_classes})")
+    return np.eye(num_classes, dtype=np.float32)[idx]
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Records → minibatch DataSets (reference
+    ``RecordReaderDataSetIterator.java``).
+
+    ``label_index``: column holding the label (-1 = no labels, features
+    only — labels mirror features like the reference's unsupervised path).
+    ``num_possible_labels`` one-hots an integer class column;
+    ``regression=True`` keeps label columns as real values, with
+    ``label_index_to`` for multi-column regression targets (reference
+    labelIndexTo).  ``max_num_batches`` truncates the pass.
+    """
+
+    def __init__(self, record_reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_possible_labels: int = -1,
+                 regression: bool = False, label_index_to: int = -1,
+                 max_num_batches: int = -1):
+        self.reader = record_reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.label_index_to = (label_index_to if label_index_to >= 0
+                               else label_index)
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.max_num_batches = max_num_batches
+        self._batch_num = 0
+        if not regression and label_index >= 0 and num_possible_labels <= 0:
+            raise ValueError("classification needs num_possible_labels")
+
+    def batch(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self.reader.reset()
+        self._batch_num = 0
+
+    def __next__(self) -> DataSet:
+        if (self.max_num_batches >= 0
+                and self._batch_num >= self.max_num_batches):
+            raise StopIteration
+        rows: List[Record] = []
+        while self.reader.has_next() and len(rows) < self._batch:
+            rows.append(self.reader.next_record())
+        if not rows:
+            raise StopIteration
+        self._batch_num += 1
+        mat = np.asarray(rows, dtype=np.float32)
+        if self.label_index < 0:
+            return self._pre(DataSet(mat, mat))
+        li, lt = self.label_index, self.label_index_to
+        feat = np.concatenate([mat[:, :li], mat[:, lt + 1:]], axis=1)
+        if self.regression:
+            labels = mat[:, li:lt + 1]
+        else:
+            labels = _one_hot(mat[:, li], self.num_possible_labels)
+        return self._pre(DataSet(feat, labels))
+
+
+class AlignmentMode:
+    """Sequence alignment modes (reference
+    ``SequenceRecordReaderDataSetIterator.AlignmentMode``)."""
+    EQUAL_LENGTH = "equal_length"
+    ALIGN_START = "align_start"
+    ALIGN_END = "align_end"
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Paired feature/label sequence readers → padded+masked time-series
+    DataSets (reference ``SequenceRecordReaderDataSetIterator.java``).
+
+    Layout is TPU-native (batch, time, features) — the reference emits
+    (batch, features, time); the recurrent tier here scans over axis 1.
+    Under ``ALIGN_START`` shorter sequences occupy leading steps with a
+    trailing mask; under ``ALIGN_END`` they occupy trailing steps —
+    i.e. labels at the final step stay aligned for seq-classification.
+    """
+
+    def __init__(self, features_reader: SequenceRecordReader,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 mini_batch_size: int = 10,
+                 num_possible_labels: int = -1,
+                 regression: bool = False,
+                 alignment_mode: str = AlignmentMode.EQUAL_LENGTH,
+                 label_index: int = -1):
+        self.features_reader = features_reader
+        self.labels_reader = labels_reader
+        self._batch = mini_batch_size
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.alignment_mode = alignment_mode
+        self.label_index = label_index  # single-reader mode
+        if labels_reader is None and label_index < 0:
+            raise ValueError("need a labels reader or a label_index")
+
+    def batch(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self.features_reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+
+    def _label_steps(self, seq: List[Record]) -> np.ndarray:
+        arr = np.asarray(seq, dtype=np.float32)
+        if self.regression:
+            return arr
+        if arr.shape[1] != 1:
+            raise ValueError("classification label records must have one "
+                             "column")
+        return _one_hot(arr[:, 0], self.num_possible_labels)
+
+    def __next__(self) -> DataSet:
+        fseqs, lseqs = [], []
+        while (self.features_reader.has_next()
+               and len(fseqs) < self._batch):
+            fs = self.features_reader.next_sequence()
+            if self.labels_reader is not None:
+                ls = self.labels_reader.next_sequence()
+            else:
+                li = self.label_index
+                ls = [[r[li]] for r in fs]
+                fs = [r[:li] + r[li + 1:] for r in fs]
+            fseqs.append(np.asarray(fs, dtype=np.float32))
+            lseqs.append(self._label_steps(ls))
+        if not fseqs:
+            raise StopIteration
+        n = len(fseqs)
+        flens = [s.shape[0] for s in fseqs]
+        llens = [s.shape[0] for s in lseqs]
+        if self.alignment_mode == AlignmentMode.EQUAL_LENGTH:
+            if len(set(flens)) > 1 or flens != llens:
+                raise ValueError(
+                    "EQUAL_LENGTH alignment requires equal sequence "
+                    f"lengths, got features {flens} labels {llens}")
+        T = max(max(flens), max(llens))
+        fdim = fseqs[0].shape[1]
+        ldim = lseqs[0].shape[1]
+        feats = np.zeros((n, T, fdim), np.float32)
+        labels = np.zeros((n, T, ldim), np.float32)
+        fmask = np.zeros((n, T), np.float32)
+        lmask = np.zeros((n, T), np.float32)
+        align_end = self.alignment_mode == AlignmentMode.ALIGN_END
+        for i, (fs, ls) in enumerate(zip(fseqs, lseqs)):
+            fo = T - fs.shape[0] if align_end else 0
+            lo = T - ls.shape[0] if align_end else 0
+            feats[i, fo:fo + fs.shape[0]] = fs
+            fmask[i, fo:fo + fs.shape[0]] = 1.0
+            labels[i, lo:lo + ls.shape[0]] = ls
+            lmask[i, lo:lo + ls.shape[0]] = 1.0
+        if self.alignment_mode == AlignmentMode.EQUAL_LENGTH:
+            return self._pre(DataSet(feats, labels))
+        return self._pre(DataSet(feats, labels, fmask, lmask))

@@ -83,7 +83,8 @@ class GraphVectors:
 
     def similarity(self, v1: int, v2: int) -> float:
         """Cosine similarity (reference ``GraphVectorsImpl.similarity``)."""
-        a, b = self._vectors[v1], self._vectors[v2]
+        vecs = self._vectors  # one host fetch (DeepWalk property copies)
+        a, b = vecs[v1], vecs[v2]
         denom = float(np.linalg.norm(a) * np.linalg.norm(b))
         return float(np.dot(a, b) / denom) if denom > 0 else 0.0
 
@@ -91,9 +92,10 @@ class GraphVectors:
         """Top-N vertices by cosine similarity, excluding the query vertex
         (reference ``GraphVectorsImpl.verticesNearest`` — priority queue
         there; one vectorised matmul + argpartition here)."""
-        v = self._vectors[vertex_idx]
-        norms = np.linalg.norm(self._vectors, axis=1) * np.linalg.norm(v)
-        sims = (self._vectors @ v) / np.maximum(norms, 1e-12)
+        vecs = self._vectors  # one host fetch (DeepWalk property copies)
+        v = vecs[vertex_idx]
+        norms = np.linalg.norm(vecs, axis=1) * np.linalg.norm(v)
+        sims = (vecs @ v) / np.maximum(norms, 1e-12)
         sims[vertex_idx] = -np.inf
         top = min(top, sims.size - 1)
         idx = np.argpartition(-sims, top - 1)[:top]
@@ -299,6 +301,8 @@ def load_txt_vectors(path: str) -> GraphVectors:
             if len(parts) < 2:
                 continue
             rows[int(parts[0])] = [float(x) for x in parts[1:]]
+    if not rows:
+        raise ValueError(f"no vectors found in {path!r}")
     n = max(rows) + 1
     dim = len(next(iter(rows.values())))
     vecs = np.zeros((n, dim), dtype=np.float32)

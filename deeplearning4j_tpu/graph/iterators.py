@@ -66,6 +66,12 @@ def generate_walks(graph: Graph, walk_length: int,
     cur = starts.copy()
     for step in range(1, walk_length + 1):
         deg = degrees[cur]
+        if (no_edge is NoEdgeHandling.EXCEPTION_ON_DISCONNECTED
+                and (deg == 0).any()):
+            bad = int(cur[deg == 0][0])
+            raise NoEdgesException(
+                f"walk reached vertex {bad} with no outgoing edges at "
+                f"step {step}")
         safe_deg = np.maximum(deg, 1)
         k = (rng.random(n) * safe_deg).astype(np.int64)
         pos = indptr[cur] + np.minimum(k, safe_deg - 1)

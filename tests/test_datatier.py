@@ -1,0 +1,305 @@
+"""Data tier tests: CIFAR-10, record readers, normalizers.
+
+Mirrors the reference test strategy: ``RecordReaderDataSetIteratorTest``
+(CSV → features/one-hot, sequence readers with alignment + masks),
+``NormalizerStandardizeTest`` / ``NormalizerMinMaxScalerTest`` (fit from
+iterator == fit from concatenated data; transform/revert round-trip), and
+a CIFAR LeNet-style smoke-train (``CifarDataSetIterator`` usage in
+``ConvolutionLayerSetupTest``).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (AlignmentMode,
+                                         CifarDataSetIterator,
+                                         CollectionRecordReader,
+                                         CollectionSequenceRecordReader,
+                                         CSVRecordReader,
+                                         CSVSequenceRecordReader, DataSet,
+                                         ImagePreProcessingScaler,
+                                         ListDataSetIterator,
+                                         NormalizerMinMaxScaler,
+                                         NormalizerStandardize,
+                                         RecordReaderDataSetIterator,
+                                         SequenceRecordReaderDataSetIterator,
+                                         cifar_arrays, load_normalizer)
+from deeplearning4j_tpu.datasets.cifar import _read_cifar_bin
+
+
+# ------------------------------------------------------------------- CIFAR
+
+class TestCifar:
+    def test_shapes_and_labels(self):
+        it = CifarDataSetIterator(32, 128, seed=3)
+        ds = next(iter(it))
+        assert ds.features.shape == (32, 32, 32, 3)
+        assert ds.labels.shape == (32, 10)
+        assert ds.features.min() >= 0.0 and ds.features.max() <= 1.0
+        np.testing.assert_allclose(ds.labels.sum(axis=1), 1.0)
+
+    def test_deterministic(self):
+        x1, y1 = cifar_arrays(num_examples=16, seed=5)
+        x2, y2 = cifar_arrays(num_examples=16, seed=5)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+
+    def test_binary_reader_layout(self, tmp_path):
+        # canonical record: label byte + planar RGB
+        n = 4
+        rng = np.random.RandomState(0)
+        labels = rng.randint(0, 10, n).astype(np.uint8)
+        planes = rng.randint(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+        recs = np.concatenate(
+            [labels[:, None], planes.reshape(n, -1)], axis=1)
+        p = tmp_path / "data_batch_1.bin"
+        recs.tofile(p)
+        imgs, lbls = _read_cifar_bin(str(p))
+        assert imgs.shape == (n, 32, 32, 3)
+        np.testing.assert_array_equal(lbls, labels)
+        # NHWC pixel (0, y, x, c) == planar (0, c, y, x)
+        np.testing.assert_allclose(imgs[0, 5, 7, 2],
+                                   planes[0, 2, 5, 7] / 255.0)
+
+    def test_smoke_train_separates_classes(self):
+        """A small conv net fits the procedural CIFAR far above chance."""
+        from deeplearning4j_tpu.nn.conf import inputs as _inputs
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            ConvolutionLayer, SubsamplingLayer)
+        from deeplearning4j_tpu.nn.layers.core import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        lb = (NeuralNetConfiguration.builder().seed(7).updater("adam")
+              .learning_rate(1e-3).weight_init("xavier").list())
+        lb.layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5),
+                                  stride=(1, 1), activation="relu"))
+        lb.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                  stride=(2, 2)))
+        lb.layer(DenseLayer(n_out=32, activation="relu"))
+        lb.layer(OutputLayer(n_out=10, activation="softmax",
+                             loss="mcxent"))
+        lb.set_input_type(_inputs.convolutional(32, 32, 3))
+        net = MultiLayerNetwork(lb.build()).init()
+        net.fit(CifarDataSetIterator(64, 1024, seed=1), epochs=3)
+        ev = net.evaluate(CifarDataSetIterator(128, 512, train=False,
+                                               seed=1))
+        assert ev.accuracy() > 0.5  # chance = 0.1
+
+
+# ----------------------------------------------------------- record readers
+
+class TestRecordReaders:
+    def test_csv_classification(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("h1,h2,h3\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n")
+        rr = CSVRecordReader(skip_num_lines=1).initialize(str(p))
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         num_possible_labels=3)
+        batches = list(it)
+        assert len(batches) == 2
+        np.testing.assert_allclose(batches[0].features,
+                                   [[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(batches[0].labels,
+                                   [[1, 0, 0], [0, 1, 0]])
+        assert batches[1].features.shape == (1, 2)
+
+    def test_regression_multi_column(self):
+        rr = CollectionRecordReader([[1, 10, 20, 5], [2, 30, 40, 6]])
+        it = RecordReaderDataSetIterator(rr, 2, label_index=1,
+                                         label_index_to=2, regression=True)
+        ds = next(iter(it))
+        np.testing.assert_allclose(ds.features, [[1, 5], [2, 6]])
+        np.testing.assert_allclose(ds.labels, [[10, 20], [30, 40]])
+
+    def test_label_out_of_range_raises(self):
+        rr = CollectionRecordReader([[0.0, 7]])
+        it = RecordReaderDataSetIterator(rr, 1, label_index=1,
+                                         num_possible_labels=3)
+        with pytest.raises(ValueError):
+            next(iter(it))
+
+    def test_max_num_batches(self):
+        rr = CollectionRecordReader([[i, 0] for i in range(10)])
+        it = RecordReaderDataSetIterator(rr, 2, label_index=1,
+                                         num_possible_labels=1,
+                                         max_num_batches=2)
+        assert len(list(it)) == 2
+
+    def test_sequence_equal_length(self):
+        feats = CollectionSequenceRecordReader(
+            [[[1, 2], [3, 4], [5, 6]], [[7, 8], [9, 10], [11, 12]]])
+        labs = CollectionSequenceRecordReader(
+            [[[0], [1], [0]], [[1], [1], [0]]])
+        it = SequenceRecordReaderDataSetIterator(
+            feats, labs, mini_batch_size=2, num_possible_labels=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 2)
+        assert ds.labels.shape == (2, 3, 2)
+        assert ds.features_mask is None
+        np.testing.assert_allclose(ds.labels[0, 1], [0, 1])
+
+    def test_sequence_align_end_masks(self):
+        feats = CollectionSequenceRecordReader(
+            [[[1], [2], [3], [4]], [[5], [6]]])
+        labs = CollectionSequenceRecordReader(
+            [[[0], [0], [0], [1]], [[1], [0]]])
+        it = SequenceRecordReaderDataSetIterator(
+            feats, labs, 2, num_possible_labels=2,
+            alignment_mode=AlignmentMode.ALIGN_END)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 4, 1)
+        # short sequence occupies the TRAILING steps
+        np.testing.assert_allclose(ds.features_mask[1], [0, 0, 1, 1])
+        np.testing.assert_allclose(ds.features[1, 2:, 0], [5, 6])
+        np.testing.assert_allclose(ds.labels_mask[1], [0, 0, 1, 1])
+
+    def test_sequence_align_start_masks(self):
+        feats = CollectionSequenceRecordReader([[[1], [2], [3]], [[5]]])
+        labs = CollectionSequenceRecordReader([[[0], [0], [1]], [[1]]])
+        it = SequenceRecordReaderDataSetIterator(
+            feats, labs, 2, num_possible_labels=2,
+            alignment_mode=AlignmentMode.ALIGN_START)
+        ds = next(iter(it))
+        np.testing.assert_allclose(ds.features_mask[1], [1, 0, 0])
+        np.testing.assert_allclose(ds.features[1, 0, 0], 5)
+
+    def test_sequence_single_reader_mode(self):
+        seqs = [[[1, 2, 0], [3, 4, 1]]]
+        rr = CollectionSequenceRecordReader(seqs)
+        it = SequenceRecordReaderDataSetIterator(
+            rr, None, 1, num_possible_labels=2, label_index=2)
+        ds = next(iter(it))
+        np.testing.assert_allclose(ds.features[0], [[1, 2], [3, 4]])
+        np.testing.assert_allclose(ds.labels[0], [[1, 0], [0, 1]])
+
+    def test_equal_length_mismatch_raises(self):
+        feats = CollectionSequenceRecordReader([[[1], [2]], [[3]]])
+        labs = CollectionSequenceRecordReader([[[0], [0]], [[1]]])
+        it = SequenceRecordReaderDataSetIterator(feats, labs, 2,
+                                                 num_possible_labels=2)
+        with pytest.raises(ValueError):
+            next(iter(it))
+
+    def test_csv_sequence_reader(self, tmp_path):
+        for i, rows in enumerate((["1,0", "2,1"], ["3,1", "4,0"])):
+            (tmp_path / f"seq_{i}.csv").write_text("\n".join(rows) + "\n")
+        rr = CSVSequenceRecordReader().initialize(str(tmp_path))
+        it = SequenceRecordReaderDataSetIterator(
+            rr, None, 2, num_possible_labels=2, label_index=1)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 2, 1)
+        np.testing.assert_allclose(ds.features[:, :, 0], [[1, 2], [3, 4]])
+
+
+# ------------------------------------------------------------- normalizers
+
+def _toy_iterator(seed=0, n=64, d=3, batch=16):
+    rng = np.random.RandomState(seed)
+    x = rng.normal([1.0, -2.0, 5.0], [2.0, 0.5, 3.0], (n, d)) \
+        .astype(np.float32)
+    y = rng.normal(10.0, 4.0, (n, 2)).astype(np.float32)
+    return ListDataSetIterator(DataSet(x, y), batch), x, y
+
+
+class TestNormalizers:
+    def test_standardize_fit_transform(self):
+        it, x, _ = _toy_iterator()
+        norm = NormalizerStandardize().fit(it)
+        np.testing.assert_allclose(norm.mean, x.mean(0), atol=1e-4)
+        np.testing.assert_allclose(norm.std, x.std(0), atol=1e-4)
+        z = norm.transform(x)
+        np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(z.std(0), 1.0, atol=1e-4)
+        np.testing.assert_allclose(norm.revert_features(z), x, atol=1e-4)
+
+    def test_standardize_labels(self):
+        it, _, y = _toy_iterator()
+        norm = NormalizerStandardize(fit_label=True).fit(it)
+        z = norm.transform_labels(y)
+        np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(norm.revert_labels(z), y, atol=1e-4)
+
+    def test_streaming_equals_full_fit(self):
+        """Per-batch accumulation == fitting the concatenated matrix."""
+        it, x, _ = _toy_iterator(batch=7)
+        a = NormalizerStandardize().fit(it)
+        b = NormalizerStandardize().fit(DataSet(x, x))
+        np.testing.assert_allclose(a.mean, b.mean, atol=1e-5)
+        np.testing.assert_allclose(a.std, b.std, atol=1e-5)
+
+    def test_minmax(self):
+        it, x, _ = _toy_iterator()
+        norm = NormalizerMinMaxScaler(0.0, 1.0).fit(it)
+        z = norm.transform(x)
+        np.testing.assert_allclose(z.min(0), 0.0, atol=1e-6)
+        np.testing.assert_allclose(z.max(0), 1.0, atol=1e-6)
+        np.testing.assert_allclose(norm.revert_features(z), x, atol=1e-4)
+
+    def test_minmax_custom_range(self):
+        it, x, _ = _toy_iterator()
+        norm = NormalizerMinMaxScaler(-1.0, 1.0).fit(it)
+        z = norm.transform(x)
+        assert abs(z.min() + 1.0) < 1e-5 and abs(z.max() - 1.0) < 1e-5
+
+    def test_time_series_masked_stats(self):
+        """Padded steps must not contaminate the statistics."""
+        x = np.zeros((2, 3, 1), np.float32)
+        x[0, :, 0] = [1, 2, 3]
+        x[1, :2, 0] = [4, 6]
+        x[1, 2, 0] = 999.0  # padding garbage
+        mask = np.array([[1, 1, 1], [1, 1, 0]], np.float32)
+        norm = NormalizerStandardize().fit(DataSet(x, x, mask))
+        np.testing.assert_allclose(norm.mean, [16 / 5], atol=1e-5)
+
+    def test_image_scaler(self):
+        imgs = np.array([[0, 127.5, 255]], np.float32)
+        sc = ImagePreProcessingScaler(0.0, 1.0)
+        np.testing.assert_allclose(sc.transform(imgs), [[0, 0.5, 1.0]])
+        np.testing.assert_allclose(sc.revert_features(
+            sc.transform(imgs)), imgs)
+
+    def test_iterator_preprocessor_hookup(self):
+        it, x, _ = _toy_iterator()
+        norm = NormalizerStandardize().fit(it)
+        it.set_preprocessor(norm)
+        batch = next(iter(it))
+        assert abs(float(np.mean(batch.features))) < 0.5
+        assert float(np.abs(batch.features).max()) < 6.0
+
+    def test_wrapper_iterators_apply_preprocessor(self):
+        from deeplearning4j_tpu.datasets import (AsyncDataSetIterator,
+                                                 MultipleEpochsIterator)
+        it, x, _ = _toy_iterator()
+        norm = NormalizerStandardize().fit(it)
+        for wrapped in (AsyncDataSetIterator(_toy_iterator()[0]),
+                        MultipleEpochsIterator(2, _toy_iterator()[0])):
+            wrapped.set_preprocessor(norm)
+            batch = next(iter(wrapped))
+            assert abs(float(np.mean(batch.features))) < 0.5
+
+    def test_normalizer_save_without_npz_suffix(self, tmp_path):
+        it, x, _ = _toy_iterator()
+        p = str(tmp_path / "norm_state")  # no .npz extension
+        norm = NormalizerStandardize().fit(it)
+        norm.save(p)
+        loaded = load_normalizer(p)
+        np.testing.assert_allclose(loaded.transform(x), norm.transform(x),
+                                   atol=1e-6)
+
+    def test_unfitted_preprocess_raises(self):
+        with pytest.raises(RuntimeError):
+            NormalizerStandardize().preprocess(
+                DataSet(np.zeros((2, 2)), np.zeros((2, 2))))
+
+    def test_save_load_round_trip(self, tmp_path):
+        it, x, _ = _toy_iterator()
+        for norm in (NormalizerStandardize().fit(it),
+                     NormalizerMinMaxScaler(-2.0, 2.0).fit(it),
+                     ImagePreProcessingScaler(0, 1)):
+            p = str(tmp_path / f"{type(norm).__name__}.npz")
+            norm.save(p)
+            loaded = load_normalizer(p)
+            np.testing.assert_allclose(loaded.transform(x),
+                                       norm.transform(x), atol=1e-6)

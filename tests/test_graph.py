@@ -123,6 +123,14 @@ class TestRandomWalks:
         with pytest.raises(NoEdgesException):
             generate_walks(g, 4, np.random.default_rng(0))
 
+    def test_mid_walk_sink_raises(self):
+        # default mode must raise even when the sink is hit mid-walk
+        g = Graph(3)
+        g.add_edge(0, 1, directed=True)
+        with pytest.raises(NoEdgesException):
+            generate_walks(g, 3, np.random.default_rng(0),
+                           start_vertices=np.array([0]))
+
     def test_self_loop_mode(self):
         g = Graph(3)
         g.add_edge(0, 1)
