@@ -16,6 +16,7 @@ handled by JAX's async dispatch.
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 from typing import Iterable, Iterator, List, Optional
@@ -40,6 +41,10 @@ class DataSetIterator:
 
     def _pre(self, ds: DataSet) -> DataSet:
         if self._preprocessor is not None:
+            # Preprocess a shallow copy: the source DataSet may be re-yielded
+            # on reset/replay (Existing/MultipleEpochs), and preprocessing
+            # the caller's object twice would double-normalize it.
+            ds = dataclasses.replace(ds)
             self._preprocessor.preprocess(ds)
         return ds
 
@@ -160,7 +165,9 @@ class AsyncDataSetIterator(DataSetIterator):
     def _worker(self) -> None:
         try:
             for ds in iter(self._under.__next__, None):
-                self._queue.put(ds)
+                # preprocess on the producer thread so the transform
+                # overlaps device execution like the rest of the prefetch
+                self._queue.put(self._pre(ds))
         except StopIteration:
             pass
         except BaseException as e:  # surfaced on the consumer thread
@@ -195,4 +202,4 @@ class AsyncDataSetIterator(DataSetIterator):
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        return self._pre(item)
+        return item

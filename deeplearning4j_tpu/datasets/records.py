@@ -27,6 +27,13 @@ from .iterators import DataSetIterator
 Record = List[Union[float, int, str]]
 
 
+def _read_csv_records(path: str, skip_num_lines: int,
+                      delimiter: str) -> List[Record]:
+    with open(path, "r", encoding="utf-8") as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    return [ln.split(delimiter) for ln in lines[skip_num_lines:] if ln]
+
+
 # ------------------------------------------------------------------ readers
 
 class RecordReader:
@@ -78,10 +85,8 @@ class CSVRecordReader(RecordReader):
         self._pos = 0
 
     def initialize(self, path: str) -> "CSVRecordReader":
-        with open(path, "r", encoding="utf-8") as f:
-            lines = [ln.rstrip("\n") for ln in f]
-        self._records = [ln.split(self.delimiter)
-                         for ln in lines[self.skip_num_lines:] if ln]
+        self._records = _read_csv_records(path, self.skip_num_lines,
+                                          self.delimiter)
         self._pos = 0
         return self
 
@@ -138,13 +143,8 @@ class CSVSequenceRecordReader(CollectionSequenceRecordReader):
             paths = sorted(
                 os.path.join(paths, n) for n in os.listdir(paths)
                 if not n.startswith("."))
-        seqs = []
-        for p in paths:
-            with open(p, "r", encoding="utf-8") as f:
-                lines = [ln.rstrip("\n") for ln in f]
-            seqs.append([ln.split(self.delimiter)
-                         for ln in lines[self.skip_num_lines:] if ln])
-        self._seqs = seqs
+        self._seqs = [_read_csv_records(p, self.skip_num_lines,
+                                        self.delimiter) for p in paths]
         self._pos = 0
         return self
 

@@ -100,10 +100,15 @@ class ParagraphVectors(SequenceVectors):
                                 self.learning_rate
                                 * (1.0 - seen / max(total + 1, 1)))
                     self._train_document(tokens, label, alpha)
+        self._flush_queues()
         return self
 
     def _train_document(self, tokens: Sequence[str], label: str,
                         alpha: float) -> None:
+        """Queue one document's training pairs.  Pairs accumulate across
+        documents into full ``batch_size`` XLA dispatches (a short document
+        no longer costs its own device round-trip — the host-dispatch-bound
+        anti-pattern the word2vec kernel design note warns about)."""
         word_idx = self._subsample_keep(self._sequence_to_indices(tokens))
         label_idx = self.vocab.index_of(label)
         if word_idx.size == 0 or label_idx < 0:
@@ -113,9 +118,7 @@ class ParagraphVectors(SequenceVectors):
         if self.sequence_algorithm == "dbow":
             # label -> each word (skip-gram, input = label row)
             inputs = np.full(word_idx.size, label_idx, np.int64)
-            for s in range(0, word_idx.size, self.batch_size):
-                sl = slice(s, s + self.batch_size)
-                self._skipgram_batch(inputs[sl], word_idx[sl], alpha)
+            self._queue_skipgram(inputs, word_idx, alpha)
         else:
             # DM: CBOW windows with the label appended to every context
             ctx, cmask, centers = self._generate_cbow(word_idx)
@@ -128,9 +131,7 @@ class ParagraphVectors(SequenceVectors):
             ctx = np.concatenate([ctx, label_col], axis=1)
             cmask = np.concatenate(
                 [cmask, np.ones((cmask.shape[0], 1), np.float32)], axis=1)
-            for s in range(0, centers.size, self.batch_size):
-                sl = slice(s, s + self.batch_size)
-                self._cbow_batch(ctx[sl], cmask[sl], centers[sl], alpha)
+            self._queue_cbow(ctx, cmask, centers, alpha)
 
     # ------------------------------------------------------------ inference
     def infer_vector(self, text, steps: int = 20,

@@ -176,6 +176,11 @@ class SequenceVectors:
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.RandomState(seed)
         self._code_arrays = None
+        # cross-sequence pair accumulators (see _queue_skipgram)
+        self._sg_queue: list = []
+        self._sg_count = 0
+        self._cb_queue: list = []
+        self._cb_count = 0
 
     # ----------------------------------------------------------- vocab prep
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:
@@ -291,6 +296,7 @@ class SequenceVectors:
                         self.learning_rate
                         * (1.0 - words_seen / max(total_words + 1, 1)))
                     self._train_sequence(seq, alpha)
+        self._flush_queues()
         return self
 
     def _train_sequence(self, seq: Sequence[str], alpha: float) -> None:
@@ -298,21 +304,89 @@ class SequenceVectors:
         indices = self._subsample_keep(indices)
         if indices.size < 2:
             return
-        lt = self.lookup_table
         if self.algorithm == "cbow":
             ctx, cmask, centers = self._generate_cbow(indices)
-            if centers.size == 0:
-                return
-            for s in range(0, centers.size, self.batch_size):
-                sl = slice(s, s + self.batch_size)
-                self._cbow_batch(ctx[sl], cmask[sl], centers[sl], alpha)
+            if centers.size:
+                self._queue_cbow(ctx, cmask, centers, alpha)
             return
         pairs = self._generate_pairs(indices)
-        if pairs.size == 0:
+        if pairs.size:
+            self._queue_skipgram(pairs[:, 0], pairs[:, 1], alpha)
+
+    # -------------------------------------------- cross-sequence batching
+    # A short sentence/document must not cost a whole device dispatch:
+    # pairs accumulate across sequences and dispatch in full
+    # ``batch_size`` chunks (the per-dispatch lr is the mean alpha of the
+    # chunk's pairs — alpha decays slowly, so this matches the reference's
+    # per-pair schedule to within one batch).  The leftover partial chunk
+    # flushes at the end of fit().
+
+    def _queue_skipgram(self, inputs: np.ndarray, targets: np.ndarray,
+                        alpha: float) -> None:
+        self._sg_queue.append((inputs.astype(np.int64),
+                               targets.astype(np.int64),
+                               np.full(inputs.size, alpha, np.float64)))
+        self._sg_count += inputs.size
+        if self._sg_count >= self.batch_size:
+            self._drain_skipgram(force=False)
+
+    def _queue_cbow(self, ctx: np.ndarray, cmask: np.ndarray,
+                    centers: np.ndarray, alpha: float) -> None:
+        self._cb_queue.append((ctx.astype(np.int64),
+                               cmask.astype(np.float32),
+                               centers.astype(np.int64),
+                               np.full(centers.size, alpha, np.float64)))
+        self._cb_count += centers.size
+        if self._cb_count >= self.batch_size:
+            self._drain_cbow(force=False)
+
+    def _drain_skipgram(self, force: bool) -> None:
+        if not self._sg_count:
             return
-        for s in range(0, len(pairs), self.batch_size):
-            batch = pairs[s:s + self.batch_size]
-            self._skipgram_batch(batch[:, 0], batch[:, 1], alpha)
+        ins = np.concatenate([q[0] for q in self._sg_queue])
+        tgts = np.concatenate([q[1] for q in self._sg_queue])
+        alphas = np.concatenate([q[2] for q in self._sg_queue])
+        B = self.batch_size
+        s = 0
+        while ins.size - s >= B or (force and s < ins.size):
+            sl = slice(s, s + B)
+            self._skipgram_batch(ins[sl], tgts[sl],
+                                 float(alphas[sl].mean()))
+            s += B
+        self._sg_queue = ([] if s >= ins.size
+                          else [(ins[s:], tgts[s:], alphas[s:])])
+        self._sg_count = max(0, ins.size - s)
+
+    def _drain_cbow(self, force: bool) -> None:
+        if not self._cb_count:
+            return
+        width = max(q[0].shape[1] for q in self._cb_queue)
+
+        def _w(a, fill):
+            pad = width - a.shape[1]
+            if not pad:
+                return a
+            return np.concatenate(
+                [a, np.full((a.shape[0], pad), fill, a.dtype)], axis=1)
+
+        ctx = np.concatenate([_w(q[0], 0) for q in self._cb_queue])
+        cmask = np.concatenate([_w(q[1], 0.0) for q in self._cb_queue])
+        ctrs = np.concatenate([q[2] for q in self._cb_queue])
+        alphas = np.concatenate([q[3] for q in self._cb_queue])
+        B = self.batch_size
+        s = 0
+        while ctrs.size - s >= B or (force and s < ctrs.size):
+            sl = slice(s, s + B)
+            self._cbow_batch(ctx[sl], cmask[sl], ctrs[sl],
+                             float(alphas[sl].mean()))
+            s += B
+        self._cb_queue = ([] if s >= ctrs.size
+                          else [(ctx[s:], cmask[s:], ctrs[s:], alphas[s:])])
+        self._cb_count = max(0, ctrs.size - s)
+
+    def _flush_queues(self) -> None:
+        self._drain_skipgram(force=True)
+        self._drain_cbow(force=True)
 
     def _pad(self, arr: np.ndarray, size: int):
         """Pad the leading axis to ``size`` (static XLA shapes) and return

@@ -60,6 +60,8 @@ class ComputationGraph:
         self._rng_key: Optional[jax.Array] = None
         self._pretrain_step_cache: Dict[str, Any] = {}
         self._pretrain_done = False
+        self._rnn_carries: Optional[Dict[str, Any]] = None
+        self._rnn_carry_batch = -1
 
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
@@ -96,9 +98,14 @@ class ComputationGraph:
     def _forward(self, params, net_state, inputs: Sequence[Array], *,
                  train: bool, rng: Optional[jax.Array],
                  input_masks: Optional[Dict[str, Array]] = None,
-                 preoutput_outputs: bool = False):
+                 preoutput_outputs: bool = False, carries=None):
         """Execute the DAG (reference forward loop ``:1048``).  Returns
-        (activations dict, new_state dict)."""
+        (activations dict, new_state dict, new_carries dict).
+
+        ``carries`` is a dict of per-recurrent-vertex carry pytrees; when
+        given, recurrent layer vertices run ``forward_seq`` with explicit
+        state in/out (the graph analogues of ``rnnTimeStep:1789`` /
+        ``rnnActivateUsingStoredState``)."""
         conf = self.conf
         acts: Dict[str, Array] = {}
         compute_dtype = conf.conf.compute_dtype
@@ -120,6 +127,7 @@ class ComputationGraph:
         # Per-vertex propagated time masks (feedForwardMaskArray analogue):
         # input masks flow along the DAG for per-timestep layers.
         masks: Dict[str, Optional[Array]] = dict(input_masks or {})
+        new_carries = dict(carries) if carries is not None else {}
 
         for name in self.topo:
             v = self.vertices[name]
@@ -136,6 +144,10 @@ class ComputationGraph:
                     if layer.dropout and train:
                         x = layer.apply_dropout(x, train, key_of[name])
                     out = layer.pre_output(params[name], x)
+                elif carries is not None and name in carries:
+                    out, new_carries[name] = layer.forward_seq(
+                        params[name], x, carries[name], train=train,
+                        rng=key_of[name], mask=mask)
                 else:
                     out, new_state[name] = layer.forward(
                         params[name], net_state[name], x, train=train,
@@ -156,19 +168,20 @@ class ComputationGraph:
         if compute_dtype:
             for out in conf.network_outputs:
                 acts[out] = acts[out].astype(jnp.float32)
-        return acts, new_state
+        return acts, new_state, new_carries
 
     # ------------------------------------------------------------------ loss
     def _loss_fn(self, params, net_state, features, labels, features_masks,
-                 labels_masks, rng, train: bool):
+                 labels_masks, rng, train: bool, carries=None):
         input_masks = None
         if features_masks is not None:
             input_masks = {n: m for n, m in zip(self.conf.network_inputs,
                                                 features_masks)
                            if m is not None}
-        acts, new_state = self._forward(
+        acts, new_state, new_carries = self._forward(
             params, net_state, features, train=train, rng=rng,
-            input_masks=input_masks, preoutput_outputs=True)
+            input_masks=input_masks, preoutput_outputs=True,
+            carries=carries)
         total = jnp.asarray(0.0, jnp.float32)
         for i, out_name in enumerate(self.conf.network_outputs):
             v = self.vertices[out_name]
@@ -193,7 +206,7 @@ class ComputationGraph:
             total = total + layer.compute_score(
                 labels[i], acts[out_name], lmask,
                 average=self.conf.conf.mini_batch)
-        return total, new_state
+        return total, (new_state, new_carries)
 
     def _reg_score(self, params) -> Array:
         total = jnp.asarray(0.0, jnp.float32)
@@ -224,7 +237,7 @@ class ComputationGraph:
         def step(params, updater_state, net_state, iteration, features,
                  labels, features_masks, labels_masks, base_rng):
             rng = jax.random.fold_in(base_rng, iteration)
-            (data_loss, new_state), grads = jax.value_and_grad(
+            (data_loss, (new_state, _)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, net_state, features, labels, features_masks,
                     labels_masks, rng, True)
@@ -236,6 +249,54 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
+    def _tbptt_step(self):
+        """Truncated-BPTT window step for the graph (reference graph tBPTT
+        path in ``ComputationGraph.doTruncatedBPTT:1936``): one
+        fwd+bwd+update over a time window with recurrent carries in from
+        the previous window, gradients stopped at the window boundary."""
+
+        def step(params, updater_state, net_state, carries, iteration,
+                 features, labels, features_masks, labels_masks, base_rng):
+            rng = jax.random.fold_in(base_rng, iteration)
+            carries = jax.lax.stop_gradient(carries)
+
+            def loss(p, ns, f, l, fm, lm, r):
+                return self._loss_fn(p, ns, f, l, fm, lm, r, True,
+                                     carries=carries)
+
+            (data_loss, (new_state, new_carries)), grads = \
+                jax.value_and_grad(loss, has_aux=True)(
+                    params, net_state, features, labels, features_masks,
+                    labels_masks, rng)
+            new_params, new_ustate = self._apply_updates(
+                params, updater_state, grads, iteration)
+            score = data_loss + self._reg_score(params)
+            return (new_params, new_ustate, new_state, new_carries, score)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    @functools.cached_property
+    def _advance_fn(self):
+        """Carry-advance without gradients or updates: used to roll state
+        over the leading ``fwd - back`` steps of a window when
+        ``tbptt_back_length < tbptt_fwd_length`` (the reference truncates
+        the LSTM backward iteration to backLength steps from the window
+        end, ``LSTMHelpers`` truncated loop), and by ``rnn_time_step``."""
+
+        def run(params, net_state, carries, features, features_masks):
+            input_masks = None
+            if features_masks is not None:
+                input_masks = {
+                    n: m for n, m in zip(self.conf.network_inputs,
+                                         features_masks) if m is not None}
+            acts, _, new_carries = self._forward(
+                params, net_state, features, train=False, rng=None,
+                input_masks=input_masks, carries=carries)
+            return [acts[o] for o in self.conf.network_outputs], new_carries
+
+        return jax.jit(run)
+
+    @functools.cached_property
     def _output_fn(self):
         def run(params, net_state, features, features_masks):
             input_masks = None
@@ -243,8 +304,9 @@ class ComputationGraph:
                 input_masks = {
                     n: m for n, m in zip(self.conf.network_inputs,
                                          features_masks) if m is not None}
-            acts, _ = self._forward(params, net_state, features, train=False,
-                                    rng=None, input_masks=input_masks)
+            acts, _, _ = self._forward(params, net_state, features,
+                                       train=False, rng=None,
+                                       input_masks=input_masks)
             return [acts[o] for o in self.conf.network_outputs]
         return jax.jit(run)
 
@@ -270,8 +332,8 @@ class ComputationGraph:
             def step(params, ustate, net_state, iteration, features,
                      base_rng):
                 rng = jax.random.fold_in(base_rng, iteration)
-                acts, _ = self._forward(params, net_state, features,
-                                        train=False, rng=None)
+                acts, _, _ = self._forward(params, net_state, features,
+                                           train=False, rng=None)
                 x = acts[v.inputs[0]]
                 if v.preprocessor is not None:
                     x = v.preprocessor(x)
@@ -382,6 +444,10 @@ class ComputationGraph:
             for m in mds.features_masks))
         lmasks = (None if mds.labels_masks is None else tuple(
             None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+        if getattr(self.conf, "backprop_type", "standard") == "tbptt":
+            for _ in range(self.conf.conf.num_iterations):
+                self._fit_tbptt(features, labels, fmasks, lmasks)
+            return
         for _ in range(self.conf.conf.num_iterations):
             (self.params, self.updater_state, self.net_state,
              score) = self._train_step(
@@ -392,6 +458,142 @@ class ComputationGraph:
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
+
+    # ---------------------------------------------------------------- tBPTT
+    def _fit_tbptt(self, features, labels, fmasks, lmasks) -> None:
+        """Graph truncated BPTT (reference
+        ``ComputationGraph.doTruncatedBPTT:1936`` +
+        ``rnnUpdateStateWithTBPTTState``): slice every 3-D input/label along
+        time into ``tbptt_fwd_length`` windows, carrying recurrent vertex
+        state across windows.  When ``tbptt_back_length <
+        tbptt_fwd_length``, the leading ``fwd - back`` steps of each window
+        advance state without gradients (the reference instead truncates
+        the LSTM backward iteration at backLength steps from the window
+        end — recurrent truncation is identical; feedforward-parameter
+        gradients from those leading steps are not accumulated here)."""
+        self._require_carry_support("truncated BPTT")
+        seq = [l for l in labels if l.ndim >= 3]
+        if not seq:
+            raise ValueError(
+                "Truncated BPTT needs per-timestep labels (batch, time, "
+                "...); use standard backprop for sequence-level labels.")
+        T = seq[0].shape[1]
+        window = self.conf.tbptt_fwd_length
+        back = self.conf.tbptt_back_length or window
+        if back > window:
+            raise ValueError(
+                f"tbptt_back_length ({back}) > tbptt_fwd_length "
+                f"({window}) is not meaningful")
+        carries = self._init_carries(features[0].shape[0])
+
+        def _t(arrs, sl, masks=False):
+            # time axis is 1 for 3-D (batch, time, feat) arrays and for
+            # 2-D (batch, time) masks; 2-D labels/static inputs and 4-D
+            # image inputs pass through whole (an image whose height
+            # happens to equal T must not be cropped)
+            def want(a):
+                return (a.ndim == 3 or (masks and a.ndim == 2)) \
+                    and a.shape[1] == T
+            return tuple(None if a is None
+                         else (a[:, sl] if want(a) else a) for a in arrs)
+
+        scores = []
+        for start in range(0, T, window):
+            stop = min(start + window, T)
+            adv = max(0, (stop - start) - back)
+            if adv:
+                asl = slice(start, start + adv)
+                _, carries = self._advance_fn(
+                    self.params, self.net_state, carries,
+                    _t(features, asl),
+                    None if fmasks is None else _t(fmasks, asl,
+                                                   masks=True))
+                start = start + adv
+            sl = slice(start, stop)
+            (self.params, self.updater_state, self.net_state, carries,
+             score) = self._tbptt_step(
+                self.params, self.updater_state, self.net_state, carries,
+                self.iteration, _t(features, sl), _t(labels, sl),
+                None if fmasks is None else _t(fmasks, sl, masks=True),
+                None if lmasks is None else _t(lmasks, sl, masks=True),
+                self._rng_key)
+            scores.append(score)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+        self._score = scores[-1] if scores else self._score
+
+    def _recurrent_vertex_names(self) -> List[str]:
+        from .layers.recurrent import BaseRecurrentLayer
+        return [n for n in self._layer_names()
+                if isinstance(self.vertices[n].layer, BaseRecurrentLayer)]
+
+    def _require_carry_support(self, what: str) -> None:
+        """Bidirectional layers cannot carry state across time chunks
+        (reference graph rnnTimeStep throws for them too)."""
+        from .layers.recurrent import BaseRecurrentLayer
+        for n in self._layer_names():
+            layer = self.vertices[n].layer
+            if (isinstance(layer, BaseRecurrentLayer)
+                    and not layer.SUPPORTS_CARRY):
+                raise ValueError(
+                    f"Vertex '{n}' ({type(layer).__name__}) does not "
+                    f"support {what}: its backward pass needs the full "
+                    "sequence")
+
+    def _init_carries(self, batch: int) -> Dict[str, Any]:
+        dtype = jnp.dtype(self.conf.conf.compute_dtype
+                          or self.conf.conf.dtype)
+        return {n: self.vertices[n].layer.init_carry(batch, dtype)
+                for n in self._recurrent_vertex_names()}
+
+    # --------------------------------------------- rnn streaming state API
+    def rnn_time_step(self, *features):
+        """Stateful streaming inference (reference
+        ``ComputationGraph.rnnTimeStep:1789``): feed one or more timesteps
+        per input, carrying every recurrent vertex's hidden state between
+        calls.  2-D inputs (batch, features) are single timesteps and the
+        matching outputs come back 2-D; 3-D inputs return full
+        (batch, time, n_out) sequences."""
+        self.init()
+        self._require_carry_support("rnn_time_step")
+        xs = [jnp.asarray(f) for f in features]
+        squeeze = xs[0].ndim == 2
+        xs = [x[:, None, :] if x.ndim == 2 else x for x in xs]
+        batch = xs[0].shape[0]
+        if self._rnn_carries is None:
+            self._rnn_carries = self._init_carries(batch)
+            self._rnn_carry_batch = batch
+        elif self._rnn_carry_batch != batch:
+            raise ValueError(
+                f"rnn_time_step batch size {batch} != stored state batch "
+                f"size {self._rnn_carry_batch}; call "
+                "rnn_clear_previous_state() between unrelated sequences")
+        outs, self._rnn_carries = self._advance_fn(
+            self.params, self.net_state, self._rnn_carries, tuple(xs),
+            None)
+        outs = [np.asarray(o) for o in outs]
+        if squeeze:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reference ``rnnClearPreviousState()``."""
+        self._rnn_carries = None
+        self._rnn_carry_batch = -1
+
+    def rnn_get_previous_state(self, vertex_name: str):
+        """Carry pytree for one recurrent vertex (reference
+        ``rnnGetPreviousState(String)``)."""
+        return (None if self._rnn_carries is None
+                else self._rnn_carries.get(vertex_name))
+
+    def rnn_set_previous_state(self, vertex_name: str, state) -> None:
+        if self._rnn_carries is None:
+            raise ValueError("No rnn state yet; call rnn_time_step first")
+        if vertex_name not in self._rnn_carries:
+            raise KeyError(f"'{vertex_name}' is not a recurrent vertex")
+        self._rnn_carries[vertex_name] = state
 
     # ------------------------------------------------------------- inference
     def output(self, *features, features_masks=None):

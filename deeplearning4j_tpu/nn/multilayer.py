@@ -346,6 +346,18 @@ class MultiLayerNetwork:
             return out, new_carries
         return jax.jit(run)
 
+    @functools.cached_property
+    def _tbptt_advance(self):
+        """Masked no-grad carry advance for the leading ``fwd - back``
+        steps of a tBPTT window (used when ``tbptt_back_length <
+        tbptt_fwd_length``)."""
+        def run(params, net_state, carries, features, fmask):
+            out, _, new_carries = self._forward(
+                params, net_state, features, train=False, rng=None,
+                mask=fmask, carries=carries)
+            return out, new_carries
+        return jax.jit(run)
+
     # -------------------------------------------------------------- pretrain
     def _pretrain_step(self, i: int):
         """Jitted one-batch unsupervised step for layer ``i``: forward the
@@ -505,18 +517,30 @@ class MultiLayerNetwork:
                 "Truncated BPTT needs per-timestep labels (batch, time, ...); "
                 f"got shape {labels.shape}. Use standard backprop for "
                 "sequence-level labels.")
-        bl = self.conf.tbptt_back_length
-        if bl and bl != self.conf.tbptt_fwd_length:
-            raise ValueError(
-                "tbptt_back_length != tbptt_fwd_length is not supported: "
-                "gradients flow through the full forward window (set both "
-                "lengths equal, the reference's common configuration)")
-        T = features.shape[1]
         window = self.conf.tbptt_fwd_length
+        back = self.conf.tbptt_back_length or window
+        if back > window:
+            raise ValueError(
+                f"tbptt_back_length ({back}) > tbptt_fwd_length "
+                f"({window}) is not meaningful")
+        T = features.shape[1]
         carries = self._init_carries(features.shape[0])
         scores = []
         for start in range(0, T, window):
-            sl = slice(start, min(start + window, T))
+            stop = min(start + window, T)
+            # back < fwd: advance state over the leading fwd-back steps
+            # without gradients (the reference truncates the LSTM backward
+            # iteration at backLength steps from the window end —
+            # recurrent truncation matches; feedforward-param gradients
+            # from the leading steps are not accumulated here)
+            adv = max(0, (stop - start) - back)
+            if adv:
+                _, carries = self._tbptt_advance(
+                    self.params, self.net_state, carries,
+                    features[:, start:start + adv],
+                    None if fmask is None else fmask[:, start:start + adv])
+                start += adv
+            sl = slice(start, stop)
             f = features[:, sl]
             l = labels[:, sl]
             fm = None if fmask is None else fmask[:, sl]

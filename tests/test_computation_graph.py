@@ -300,3 +300,122 @@ def test_resnet50_builds_with_canonical_param_count():
     out = cg.output(np.random.randn(2, 32, 32, 3).astype(np.float32))
     assert out.shape == (2, 1000)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-3)
+
+
+# ----------------------------------------- graph rnnTimeStep + graph tBPTT
+
+def _seq_graph(tbptt=None, back=None, seed=12345, n_in=3, n_out=3):
+    b = (_builder(seed).add_inputs("seq")
+         .add_layer("lstm1", GravesLSTM(n_in=n_in, n_out=4), "seq")
+         .add_layer("lstm2", GravesLSTM(n_in=4, n_out=4), "lstm1")
+         .add_layer("rnnout", RnnOutputLayer(n_in=4, n_out=n_out), "lstm2")
+         .set_outputs("rnnout"))
+    if tbptt:
+        b = b.backprop_type("tbptt").t_bptt_forward_length(tbptt)
+        if back:
+            b = b.t_bptt_backward_length(back)
+    return ComputationGraph(b.build()).init()
+
+
+def _seq_batch(n=4, t=6, n_in=3, n_cls=3, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, t, n_in)
+    Y = np.eye(n_cls)[rng.randint(0, n_cls, (n, t))]
+    return MultiDataSet(features=[X], labels=[Y])
+
+
+def test_graph_rnn_time_step_matches_full_sequence():
+    cg = _seq_graph()
+    mds = _seq_batch()
+    full = cg.output(*mds.features)
+    cg.rnn_clear_previous_state()
+    stepped = [cg.rnn_time_step(mds.features[0][:, t])
+               for t in range(mds.features[0].shape[1])]
+    np.testing.assert_allclose(full, np.stack(stepped, axis=1),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_graph_rnn_time_step_chunked_matches():
+    cg = _seq_graph()
+    mds = _seq_batch()
+    full = cg.output(*mds.features)
+    cg.rnn_clear_previous_state()
+    a = cg.rnn_time_step(mds.features[0][:, :2])
+    b = cg.rnn_time_step(mds.features[0][:, 2:])
+    np.testing.assert_allclose(full, np.concatenate([a, b], axis=1),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_graph_rnn_clear_state_resets():
+    cg = _seq_graph()
+    mds = _seq_batch()
+    x0 = mds.features[0][:, 0]
+    first = cg.rnn_time_step(x0)
+    assert not np.allclose(first, cg.rnn_time_step(x0))
+    cg.rnn_clear_previous_state()
+    np.testing.assert_allclose(first, cg.rnn_time_step(x0))
+
+
+def test_graph_rnn_state_get_set_and_batch_guard():
+    cg = _seq_graph()
+    mds = _seq_batch()
+    cg.rnn_time_step(mds.features[0][:, 0])
+    st = cg.rnn_get_previous_state("lstm1")
+    assert st is not None
+    cg.rnn_set_previous_state("lstm1", st)
+    with pytest.raises(KeyError):
+        cg.rnn_set_previous_state("rnnout_nope", st)
+    with pytest.raises(ValueError):
+        cg.rnn_time_step(mds.features[0][:1, 0])
+
+
+def test_graph_tbptt_equals_standard_when_window_covers_sequence():
+    mds = _seq_batch()
+    a = _seq_graph(tbptt=6)
+    b = _seq_graph()
+    a.fit(mds)
+    b.fit(mds)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-10)
+
+
+def test_graph_tbptt_training_decreases_score():
+    rng = np.random.RandomState(7)
+    X = rng.randn(16, 12, 3)
+    cls = (np.cumsum(X.sum(-1), axis=1) > 0).astype(int)
+    Y = np.eye(3)[cls + 1]
+    mds = MultiDataSet(features=[X], labels=[Y])
+    cg = _seq_graph(tbptt=4)
+    cg.fit(mds)
+    s0 = cg.score(mds)
+    cg.fit(mds, epochs=30)
+    assert cg.score(mds) < s0 * 0.7
+    assert cg.iteration == 31 * 3  # 12 steps / window 4 per fit call
+
+
+def test_graph_tbptt_back_shorter_than_fwd_trains():
+    rng = np.random.RandomState(9)
+    X = rng.randn(8, 12, 3)
+    cls = (np.cumsum(X.sum(-1), axis=1) > 0).astype(int)
+    Y = np.eye(3)[cls + 1]
+    mds = MultiDataSet(features=[X], labels=[Y])
+    cg = _seq_graph(tbptt=6, back=3)
+    cg.fit(mds)
+    s0 = cg.score(mds)
+    cg.fit(mds, epochs=25)
+    assert cg.score(mds) < s0
+
+
+def test_graph_tbptt_back_longer_than_fwd_raises():
+    cg = _seq_graph(tbptt=4, back=6)
+    with pytest.raises(ValueError):
+        cg.fit(_seq_batch())
+
+
+def test_graph_tbptt_sequence_level_labels_raise():
+    cg = _seq_graph(tbptt=4)
+    rng = np.random.RandomState(0)
+    mds = MultiDataSet(features=[rng.randn(4, 6, 3)],
+                       labels=[np.eye(3)[rng.randint(0, 3, 4)]])
+    with pytest.raises(ValueError):
+        cg.fit(mds)

@@ -279,6 +279,21 @@ class TestNormalizers:
             batch = next(iter(wrapped))
             assert abs(float(np.mean(batch.features))) < 0.5
 
+    def test_replay_does_not_double_normalize(self):
+        from deeplearning4j_tpu.datasets import (ExistingDataSetIterator,
+                                                 MultipleEpochsIterator)
+        it, x, y = _toy_iterator()
+        norm = NormalizerStandardize().fit(it)
+        src = DataSet(x.copy(), y.copy())
+        wrapped = MultipleEpochsIterator(3, ExistingDataSetIterator([src]))
+        wrapped.set_preprocessor(norm)
+        means = [float(np.mean(b.features)) for b in wrapped]
+        assert len(means) == 3
+        # every epoch sees identically-normalized data; the source object
+        # is never mutated
+        np.testing.assert_allclose(means, means[0], atol=1e-6)
+        np.testing.assert_array_equal(src.features, x)
+
     def test_normalizer_save_without_npz_suffix(self, tmp_path):
         it, x, _ = _toy_iterator()
         p = str(tmp_path / "norm_state")  # no .npz extension

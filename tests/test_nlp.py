@@ -339,3 +339,25 @@ def test_rnn_trains_on_word_vector_iterator():
         correct += (out.argmax(1) == np.asarray(ds.labels).argmax(1)).sum()
         total += out.shape[0]
     assert correct / total > 0.9
+
+
+def test_paragraph_vectors_batches_across_documents(monkeypatch):
+    """Many short docs must accumulate into few full-batch dispatches, not
+    one dispatch per document (host-dispatch-bound anti-pattern)."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    docs = [f"w{i} w{(i+1) % 12} w{(i+2) % 12} w{(i+3) % 12}"
+            for i in range(30)]
+    pv = ParagraphVectors(sequence_learning_algorithm="dbow",
+                          layer_size=8, window_size=2, batch_size=4096,
+                          seed=1, epochs=1)
+    calls = {"n": 0}
+    orig = ParagraphVectors._skipgram_batch
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(ParagraphVectors, "_skipgram_batch", counting)
+    pv.fit(docs)
+    # 30 docs worth of pairs fit one 4096 batch: exactly 1 flush dispatch
+    assert calls["n"] == 1

@@ -31,7 +31,7 @@ def _seq_ds(n=4, t=6, n_in=3, n_classes=3, seed=0, mask=False):
     return DataSet(X, Y, features_mask=fm, labels_mask=fm)
 
 
-def _net(layers, tbptt=None, seed=12345):
+def _net(layers, tbptt=None, tbptt_back=None, seed=12345):
     b = (NeuralNetConfiguration.builder().seed(seed)
          .dtype("float64").updater("sgd").learning_rate(0.1)
          .activation("tanh").weight_init("xavier"))
@@ -41,7 +41,8 @@ def _net(layers, tbptt=None, seed=12345):
     lb.set_input_type(inputs.recurrent(3, 6))
     if tbptt:
         lb.backprop_type("tbptt")
-        lb.t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+        lb.t_bptt_forward_length(tbptt)
+        lb.t_bptt_backward_length(tbptt_back or tbptt)
     return MultiLayerNetwork(lb.build()).init()
 
 
@@ -163,6 +164,29 @@ def test_tbptt_equals_standard_when_window_covers_sequence():
     b.fit(ds)
     np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
                                rtol=1e-10)
+
+
+def test_tbptt_back_shorter_than_fwd_trains():
+    # back < fwd: leading window steps advance state without gradients
+    rng = np.random.RandomState(9)
+    X = rng.randn(8, 12, 3)
+    cls = (np.cumsum(X.sum(-1), axis=1) > 0).astype(int)
+    Y = np.eye(3)[cls + 1]
+    ds = DataSet(X, Y)
+    net = _net([GravesLSTM(n_out=8), RnnOutputLayer(n_out=3)],
+               tbptt=6, tbptt_back=3)
+    net.fit(ds)
+    s0 = net.score(ds)
+    net.fit(ds, epochs=25)
+    assert net.score(ds) < s0
+
+
+def test_tbptt_back_longer_than_fwd_raises():
+    ds = _seq_ds()
+    net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)],
+               tbptt=4, tbptt_back=6)
+    with pytest.raises(ValueError):
+        net.fit(ds)
 
 
 # ------------------------------------------------------------------- serde
