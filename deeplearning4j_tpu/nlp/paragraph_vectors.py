@@ -90,6 +90,7 @@ class ParagraphVectors(SequenceVectors):
         self._docs = docs
         if self.vocab is None:
             self.build_vocab_from_documents(docs)
+        self._reset_queues()  # drop stale pairs from an aborted prior fit
         total = sum(len(t) for t, _ in docs) * self.epochs * self.iterations
         seen = 0
         for _ in range(self.epochs):

@@ -284,6 +284,7 @@ class SequenceVectors:
         seq_list = [list(s) for s in sequences]
         if self.vocab is None:
             self.build_vocab(seq_list)
+        self._reset_queues()  # drop stale pairs from an aborted prior fit
         total_words = sum(len(s) for s in seq_list) * self.epochs \
             * self.iterations
         words_seen = 0
@@ -387,6 +388,12 @@ class SequenceVectors:
     def _flush_queues(self) -> None:
         self._drain_skipgram(force=True)
         self._drain_cbow(force=True)
+
+    def _reset_queues(self) -> None:
+        self._sg_queue = []
+        self._sg_count = 0
+        self._cb_queue = []
+        self._cb_count = 0
 
     def _pad(self, arr: np.ndarray, size: int):
         """Pad the leading axis to ``size`` (static XLA shapes) and return

@@ -63,6 +63,20 @@ class ComputationGraph:
         self._rnn_carries: Optional[Dict[str, Any]] = None
         self._rnn_carry_batch = -1
 
+    @functools.cached_property
+    def _solver(self):
+        """Line-search solver when ``optimization_algo`` asks for one
+        (reference ``Solver.java``); None selects the jitted SGD path."""
+        from ..optimize.solvers import SGD, Solver
+        algo = (self.conf.conf.optimization_algo or SGD).lower()
+        if algo == SGD:
+            return None
+        if getattr(self.conf, "backprop_type", "standard") == "tbptt":
+            raise ValueError(
+                f"optimization_algo {algo!r} is incompatible with tBPTT; "
+                "use stochastic_gradient_descent")
+        return Solver(self, algo)
+
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
         if self._init_done:
@@ -444,6 +458,14 @@ class ComputationGraph:
             for m in mds.features_masks))
         lmasks = (None if mds.labels_masks is None else tuple(
             None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+        if self._solver is not None:
+            for _ in range(self.conf.conf.num_iterations):
+                self._score = self._solver.optimize(features, labels,
+                                                    fmasks, lmasks)
+                self.iteration += 1
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+            return
         if getattr(self.conf, "backprop_type", "standard") == "tbptt":
             for _ in range(self.conf.conf.num_iterations):
                 self._fit_tbptt(features, labels, fmasks, lmasks)
@@ -472,7 +494,13 @@ class ComputationGraph:
         end — recurrent truncation is identical; feedforward-parameter
         gradients from those leading steps are not accumulated here)."""
         self._require_carry_support("truncated BPTT")
-        seq = [l for l in labels if l.ndim >= 3]
+        if any(l.ndim > 3 for l in labels):
+            raise ValueError(
+                "Graph tBPTT supports (batch, time, features) labels only; "
+                "got a label of rank "
+                f"{max(l.ndim for l in labels)} (4-D per-timestep targets "
+                "are not time-sliceable here)")
+        seq = [l for l in labels if l.ndim == 3]
         if not seq:
             raise ValueError(
                 "Truncated BPTT needs per-timestep labels (batch, time, "

@@ -49,6 +49,22 @@ class MultiLayerNetwork:
         self._rnn_carry_batch = -1
         self._pretrain_step_cache: Dict[int, Any] = {}
         self._pretrain_done = False
+        self._tbptt_step_cache: Dict[int, Any] = {}
+
+    @functools.cached_property
+    def _solver(self):
+        """Line-search solver when ``optimization_algo`` asks for one
+        (reference ``Solver.java``); None selects the jitted SGD path.
+        Unknown algorithms raise instead of silently training with SGD."""
+        from ..optimize.solvers import SGD, Solver
+        algo = (self.conf.conf.optimization_algo or SGD).lower()
+        if algo == SGD:
+            return None
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError(
+                f"optimization_algo {algo!r} is incompatible with tBPTT; "
+                "use stochastic_gradient_descent")
+        return Solver(self, algo)
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -79,7 +95,7 @@ class MultiLayerNetwork:
     # --------------------------------------------------------------- forward
     def _forward(self, params, net_state, x, *, train: bool,
                  rng: Optional[jax.Array], mask=None, carries=None,
-                 to_layer: Optional[int] = None,
+                 to_layer: Optional[int] = None, from_layer: int = 0,
                  preoutput_last: bool = False):
         """Compose preprocessors + layers (reference ``feedForwardToLayer``).
 
@@ -89,6 +105,8 @@ class MultiLayerNetwork:
         ``rnn_time_step``; None runs every recurrent layer from zero state.
         With ``preoutput_last`` the final (output) layer contributes its
         pre-activation, letting the loss fuse softmax/sigmoid stably.
+        ``from_layer`` starts composition mid-stack with ``x`` as that
+        layer's input (the suffix path of the exact-tBPTT split).
         """
         from .layers.recurrent import BaseRecurrentLayer
         n = len(self.layers) if to_layer is None else to_layer + 1
@@ -109,7 +127,7 @@ class MultiLayerNetwork:
             params = jax.tree.map(
                 lambda p: p.astype(cast)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
-        for i in range(n):
+        for i in range(from_layer, n):
             layer = self.layers[i]
             if i in self.conf.input_preprocessors:
                 x = self.conf.input_preprocessors[i](x)
@@ -132,11 +150,13 @@ class MultiLayerNetwork:
 
     # ----------------------------------------------------------------- loss
     def _loss_fn(self, params, net_state, features, labels, features_mask,
-                 labels_mask, rng, train: bool, carries=None):
+                 labels_mask, rng, train: bool, carries=None,
+                 from_layer: int = 0):
         """Data loss (+ new state, new carries).  Regularization is handled
         updater-side to match the reference order of operations (SURVEY.md §7
         hard part d); the reported score adds the reg term separately
-        (``BaseLayer.calcL2``)."""
+        (``BaseLayer.calcL2``).  ``from_layer`` scores a mid-stack
+        activation through the remaining layers (exact-tBPTT suffix)."""
         out_layer = self.layers[-1]
         if getattr(out_layer, "NEEDS_INPUT_FOR_SCORE", False):
             # Center-loss-style heads score against the layer *input* (the
@@ -144,7 +164,8 @@ class MultiLayerNetwork:
             n = len(self.layers)
             x, new_state, new_carries = self._forward(
                 params, net_state, features, train=train, rng=rng,
-                mask=features_mask, carries=carries, to_layer=n - 2)
+                mask=features_mask, carries=carries, to_layer=n - 2,
+                from_layer=from_layer)
             if (n - 1) in self.conf.input_preprocessors:
                 x = self.conf.input_preprocessors[n - 1](x)
             if out_layer.dropout and train:
@@ -157,7 +178,8 @@ class MultiLayerNetwork:
             return data_loss, (new_state, new_carries)
         preout, new_state, new_carries = self._forward(
             params, net_state, features, train=train, rng=rng,
-            mask=features_mask, carries=carries, preoutput_last=True)
+            mask=features_mask, carries=carries, preoutput_last=True,
+            from_layer=from_layer)
         if not hasattr(out_layer, "compute_score"):
             raise ValueError(
                 "Last layer must be an output/loss layer to fit()")
@@ -288,33 +310,81 @@ class MultiLayerNetwork:
             listener.iteration_done(self, self.iteration)
         return np.asarray(scores)
 
-    @functools.cached_property
-    def _tbptt_step(self):
+    def _last_stateful_recurrent(self) -> int:
+        """Index of the deepest layer carrying real recurrent state (-1 if
+        none); the exact-tBPTT split point.  RnnOutputLayer-style
+        time-distributed heads have an empty carry and sit in the suffix."""
+        from .layers.recurrent import BaseRecurrentLayer
+        last = -1
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, BaseRecurrentLayer) \
+                    and layer.init_carry(1, jnp.float32) != ():
+                last = i
+        return last
+
+    def _tbptt_step_for(self, adv: int):
         """Truncated-BPTT window step (reference ``doTruncatedBPTT:1138``):
-        one fwd+bwd+update over a time window, with recurrent state carried
-        in from the previous window and treated as a constant (gradients do
-        not flow across window boundaries)."""
+        one fwd+bwd+update over a ``tbptt_fwd_length`` window with carries
+        in from the previous window, gradients stopped at the window
+        boundary.
 
-        def step(params, updater_state, net_state, carries, iteration,
-                 features, labels, features_mask, labels_mask, base_rng):
-            rng = jax.random.fold_in(base_rng, iteration)
-            carries = jax.lax.stop_gradient(carries)
+        ``adv`` > 0 reproduces the reference's ``tbptt_back_length <
+        fwd`` semantics exactly (``LSTMHelpers`` truncated backward loop):
+        the leading ``adv`` steps run through the recurrent trunk with
+        stopped gradients, then score through the suffix layers normally —
+        so layers above the last recurrent layer accumulate gradients from
+        ALL window steps while the recurrent trunk sees only the trailing
+        ``back`` steps, matching the reference's per-layer truncation.
+        """
+        if adv not in self._tbptt_step_cache:
+            last_rec = self._last_stateful_recurrent()
 
-            def loss(p, ns, f, l, fm, lm, r):
-                return self._loss_fn(p, ns, f, l, fm, lm, r, True,
-                                     carries=carries)
+            def step(params, updater_state, net_state, carries, iteration,
+                     features, labels, features_mask, labels_mask,
+                     base_rng):
+                rng = (jax.random.fold_in(base_rng, iteration)
+                       if base_rng is not None else None)
+                carries = jax.lax.stop_gradient(carries)
 
-            (data_loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                loss, has_aux=True)(
-                    params, net_state, features, labels, features_mask,
-                    labels_mask, rng)
-            new_params, new_updater_state = self._apply_updates(
-                params, updater_state, grads, iteration)
-            score = data_loss + self._reg_score(params)
-            return (new_params, new_updater_state, new_state, new_carries,
-                    score)
+                def loss(p, ns, f, l, fm, lm, r):
+                    if adv == 0:
+                        return self._loss_fn(p, ns, f, l, fm, lm, r, True,
+                                             carries=carries)
+                    rA = rB = None
+                    if r is not None:
+                        rA = jax.random.fold_in(r, 0)
+                        rB = jax.random.fold_in(r, 1)
+                    fmA = None if fm is None else fm[:, :adv]
+                    # leading steps: recurrent trunk, gradients stopped
+                    trunk, _, mid = self._forward(
+                        p, ns, f[:, :adv], train=True, rng=rA, mask=fmA,
+                        carries=carries, to_layer=last_rec)
+                    trunk = jax.lax.stop_gradient(trunk)
+                    mid = jax.lax.stop_gradient(mid)
+                    loss_a, _ = self._loss_fn(
+                        p, ns, trunk, l[:, :adv], fmA,
+                        None if lm is None else lm[:, :adv], rA, True,
+                        from_layer=last_rec + 1)
+                    loss_b, aux = self._loss_fn(
+                        p, ns, f[:, adv:], l[:, adv:],
+                        None if fm is None else fm[:, adv:],
+                        None if lm is None else lm[:, adv:], rB, True,
+                        carries=mid)
+                    return loss_a + loss_b, aux
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+                (data_loss, (new_state, new_carries)), grads = \
+                    jax.value_and_grad(loss, has_aux=True)(
+                        params, net_state, features, labels, features_mask,
+                        labels_mask, rng)
+                new_params, new_updater_state = self._apply_updates(
+                    params, updater_state, grads, iteration)
+                score = data_loss + self._reg_score(params)
+                return (new_params, new_updater_state, new_state,
+                        new_carries, score)
+
+            self._tbptt_step_cache[adv] = jax.jit(
+                step, donate_argnums=(0, 1, 2, 3))
+        return self._tbptt_step_cache[adv]
 
     @functools.cached_property
     def _score_fn(self):
@@ -343,18 +413,6 @@ class MultiLayerNetwork:
             out, _, new_carries = self._forward(
                 params, net_state, features, train=False, rng=None,
                 carries=carries)
-            return out, new_carries
-        return jax.jit(run)
-
-    @functools.cached_property
-    def _tbptt_advance(self):
-        """Masked no-grad carry advance for the leading ``fwd - back``
-        steps of a tBPTT window (used when ``tbptt_back_length <
-        tbptt_fwd_length``)."""
-        def run(params, net_state, carries, features, fmask):
-            out, _, new_carries = self._forward(
-                params, net_state, features, train=False, rng=None,
-                mask=fmask, carries=carries)
             return out, new_carries
         return jax.jit(run)
 
@@ -491,6 +549,15 @@ class MultiLayerNetwork:
         fmask = (None if ds.features_mask is None
                  else jnp.asarray(ds.features_mask))
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if self._solver is not None:
+            # line-search solver family (reference Solver.optimize path)
+            for _ in range(self.conf.conf.num_iterations):
+                self._score = self._solver.optimize(features, labels,
+                                                    fmask, lmask)
+                self.iteration += 1
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+            return
         if self.conf.backprop_type == "tbptt":
             for _ in range(self.conf.conf.num_iterations):
                 self._fit_tbptt(features, labels, fmask, lmask)
@@ -528,25 +595,17 @@ class MultiLayerNetwork:
         scores = []
         for start in range(0, T, window):
             stop = min(start + window, T)
-            # back < fwd: advance state over the leading fwd-back steps
-            # without gradients (the reference truncates the LSTM backward
-            # iteration at backLength steps from the window end —
-            # recurrent truncation matches; feedforward-param gradients
-            # from the leading steps are not accumulated here)
+            # back < fwd: loss covers the WHOLE window; the leading
+            # fwd-back steps run the recurrent trunk gradient-stopped
+            # (exact reference semantics — see _tbptt_step_for)
             adv = max(0, (stop - start) - back)
-            if adv:
-                _, carries = self._tbptt_advance(
-                    self.params, self.net_state, carries,
-                    features[:, start:start + adv],
-                    None if fmask is None else fmask[:, start:start + adv])
-                start += adv
             sl = slice(start, stop)
             f = features[:, sl]
             l = labels[:, sl]
             fm = None if fmask is None else fmask[:, sl]
             lm = None if lmask is None else lmask[:, sl]
             (self.params, self.updater_state, self.net_state, carries,
-             score) = self._tbptt_step(
+             score) = self._tbptt_step_for(adv)(
                 self.params, self.updater_state, self.net_state, carries,
                 self.iteration, f, l, fm, lm, self._rng_key)
             scores.append(score)

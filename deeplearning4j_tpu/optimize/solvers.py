@@ -1,0 +1,279 @@
+"""Solver family: line-search optimizers (LBFGS / ConjugateGradient /
+LineGradientDescent) + BackTrackLineSearch.
+
+TPU-native equivalents of the reference's
+``optimize/Solver.java`` + ``optimize/solvers/BaseOptimizer.java``
+(gradient → search direction → line search → step),
+``solvers/LBFGS.java`` (Nocedal & Wright §7.2 two-loop recursion, m=4),
+``solvers/ConjugateGradient.java`` (Polak-Ribière with restart),
+``solvers/LineGradientDescent.java`` and
+``solvers/BackTrackLineSearch.java`` (Armijo backtracking, maxIterations
+default 5).
+
+Redesign for XLA: the reference mutates a flat params INDArray on the host
+between per-step dispatches.  Here the whole solver iteration — loss+grad,
+direction (two-loop recursion unrolled over the m history slots), the
+entire backtracking loop (``lax.while_loop``), the parameter step and the
+history update — is ONE jitted program over the raveled parameter vector
+(``jax.flatten_util.ravel_pytree``).  Solver state (CG's previous
+direction, LBFGS's s/y/rho ring buffers) is a pytree carried between
+calls, so multi-iteration fits stay on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+Array = jax.Array
+
+SGD = "stochastic_gradient_descent"
+LINE_GRADIENT_DESCENT = "line_gradient_descent"
+CONJUGATE_GRADIENT = "conjugate_gradient"
+LBFGS = "lbfgs"
+
+LINE_SEARCH_ALGOS = (LINE_GRADIENT_DESCENT, CONJUGATE_GRADIENT, LBFGS)
+ALL_ALGOS = (SGD,) + LINE_SEARCH_ALGOS
+
+_LBFGS_M = 4  # history size (reference LBFGS.java `private int m = 4`)
+
+
+def backtrack_line_search(loss_fn: Callable[[Array], Array], w: Array,
+                          f0: Array, g0: Array, direction: Array,
+                          max_iterations: int = 5,
+                          initial_step: float = 1.0,
+                          c1: float = 1e-4,
+                          backtrack: float = 0.5) -> Array:
+    """Armijo backtracking (reference ``BackTrackLineSearch.optimize``):
+    start at ``initial_step`` and halve until
+    ``f(w + a*d) <= f0 + c1 * a * g0·d`` or the iteration budget runs out.
+    Returns the accepted step size (0.0 on failure — caller falls back),
+    as a traced scalar inside one jitted program."""
+    slope = jnp.vdot(g0, direction)
+
+    def cond(state):
+        a, i, ok = state
+        return jnp.logical_and(~ok, i < max_iterations)
+
+    def body(state):
+        a, i, _ = state
+        f_new = loss_fn(w + a * direction)
+        ok = f_new <= f0 + c1 * a * slope
+        return jnp.where(ok, a, a * backtrack), i + 1, ok
+
+    a, _, ok = jax.lax.while_loop(
+        cond, body, (jnp.asarray(initial_step, w.dtype),
+                     jnp.asarray(0, jnp.int32), jnp.asarray(False)))
+    # A descent direction is required for Armijo to be meaningful; a
+    # non-descent direction fails every test and returns 0.
+    return jnp.where(jnp.logical_and(ok, slope < 0), a,
+                     jnp.zeros((), w.dtype))
+
+
+class SolverState(NamedTuple):
+    """Carried solver search state (reference ``BaseOptimizer.searchState``
+    map).  Unused slots stay zero for the simpler algorithms."""
+    prev_grad: Array        # CG + LBFGS
+    prev_dir: Array         # CG
+    prev_w: Array           # LBFGS (oldparams)
+    s_buf: Array            # LBFGS (m, n) param differences
+    y_buf: Array            # LBFGS (m, n) grad differences
+    rho_buf: Array          # LBFGS (m,)
+    count: Array            # LBFGS number of stored pairs
+    step_num: Array         # iterations completed (0 = no history yet)
+
+
+def init_solver_state(n: int, dtype=jnp.float32) -> SolverState:
+    # distinct buffers: the state is donated into the jitted step, and XLA
+    # rejects donating one buffer twice
+    return SolverState(
+        prev_grad=jnp.zeros((n,), dtype),
+        prev_dir=jnp.zeros((n,), dtype),
+        prev_w=jnp.zeros((n,), dtype),
+        s_buf=jnp.zeros((_LBFGS_M, n), dtype),
+        y_buf=jnp.zeros((_LBFGS_M, n), dtype),
+        rho_buf=jnp.zeros((_LBFGS_M,), dtype),
+        count=jnp.zeros((), jnp.int32),
+        step_num=jnp.zeros((), jnp.int32))
+
+
+def _cg_direction(g: Array, state: SolverState) -> Array:
+    """Polak-Ribière conjugate direction with automatic restart (reference
+    ``ConjugateGradient.preProcessLine``: beta = max(0, g·(g-g_prev)/
+    g_prev·g_prev); dl4j restarts on beta 0)."""
+    denom = jnp.vdot(state.prev_grad, state.prev_grad)
+    beta = jnp.where(denom > 0,
+                     jnp.maximum(jnp.vdot(g, g - state.prev_grad)
+                                 / jnp.maximum(denom, 1e-30), 0.0),
+                     0.0)
+    d = -g + beta * state.prev_dir
+    # restart with steepest descent if not a descent direction
+    return jnp.where(jnp.vdot(d, g) < 0, d, -g)
+
+
+def _lbfgs_direction(g: Array, state: SolverState) -> Array:
+    """Two-loop recursion (Nocedal & Wright §7.2; reference
+    ``LBFGS.postStep``), unrolled over the fixed m=4 ring buffer with
+    zero-rho slots masked out."""
+    q = g
+    alphas = []
+    # newest → oldest (ring buffer: slot (count-1-k) mod m)
+    for k in range(_LBFGS_M):
+        idx = jnp.mod(state.count - 1 - k, _LBFGS_M)
+        valid = k < state.count
+        s = state.s_buf[idx]
+        y = state.y_buf[idx]
+        rho = state.rho_buf[idx]
+        alpha = jnp.where(valid, rho * jnp.vdot(s, q), 0.0)
+        q = q - alpha * y * jnp.where(valid, 1.0, 0.0)
+        alphas.append((alpha, idx, valid))
+    # initial Hessian scaling gamma = s·y / y·y of the newest pair
+    newest = jnp.mod(state.count - 1, _LBFGS_M)
+    sy = jnp.vdot(state.s_buf[newest], state.y_buf[newest])
+    yy = jnp.vdot(state.y_buf[newest], state.y_buf[newest])
+    gamma = jnp.where(jnp.logical_and(state.count > 0, yy > 0),
+                      sy / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma * q
+    for alpha, idx, valid in reversed(alphas):
+        y = state.y_buf[idx]
+        s = state.s_buf[idx]
+        rho = state.rho_buf[idx]
+        beta = jnp.where(valid, rho * jnp.vdot(y, r), 0.0)
+        r = r + (alpha - beta) * s * jnp.where(valid, 1.0, 0.0)
+    d = -r
+    return jnp.where(jnp.vdot(d, g) < 0, d, -g)
+
+
+def _update_lbfgs_history(state: SolverState, w: Array, g: Array
+                          ) -> SolverState:
+    """Push (s, y, rho) for the completed step into the ring buffer
+    (reference ``LBFGS.postStep``; pairs with s·y <= 0 are skipped to keep
+    the inverse-Hessian approximation positive definite)."""
+    s = w - state.prev_w
+    y = g - state.prev_grad
+    sy = jnp.vdot(s, y)
+    ok = jnp.logical_and(state.count >= 0, sy > 1e-10)
+    slot = jnp.mod(state.count, _LBFGS_M)
+
+    def push(bufs):
+        s_buf, y_buf, rho_buf, count = bufs
+        return (s_buf.at[slot].set(s), y_buf.at[slot].set(y),
+                rho_buf.at[slot].set(1.0 / sy), count + 1)
+
+    def keep(bufs):
+        return bufs
+
+    s_buf, y_buf, rho_buf, count = jax.lax.cond(
+        ok, push, keep,
+        (state.s_buf, state.y_buf, state.rho_buf, state.count))
+    return state._replace(s_buf=s_buf, y_buf=y_buf, rho_buf=rho_buf,
+                          count=count)
+
+
+class Solver:
+    """Line-search solver over a network's full-batch loss (reference
+    ``optimize/Solver.java`` builder + ``BaseOptimizer.optimize``).
+
+    ``net`` provides ``params`` (pytree) and ``_loss_fn``; one
+    ``optimize(...)`` call runs ``num_iterations`` solver iterations in a
+    scan, entirely on-device.  The configured updater is NOT applied —
+    the line search chooses the step size (the reference's step-function
+    path); regularization enters through the loss like the SGD path.
+    """
+
+    def __init__(self, net, algo: str,
+                 max_line_search_iterations: int = 10):
+        algo = algo.lower()
+        if algo not in LINE_SEARCH_ALGOS:
+            raise ValueError(
+                f"Unknown/unsupported optimization_algo {algo!r}; expected "
+                f"one of {ALL_ALGOS}")
+        self.net = net
+        self.algo = algo
+        self.max_ls = max_line_search_iterations
+        self._state: Optional[SolverState] = None
+        self._unravel = None
+
+    def _flat_loss(self, net_state, batch):
+        """loss(flat_w) closure for the current batch shapes.  Evaluated
+        deterministically (TEST-mode forward, like the gradient checker):
+        Armijo comparisons across trial steps need a noise-free loss."""
+        features, labels, fmask, lmask = batch
+        net = self.net
+
+        def loss(flat_w):
+            params = self._unravel(flat_w)
+            data_loss, _ = net._loss_fn(params, net_state, features,
+                                        labels, fmask, lmask, None, False)
+            return data_loss + net._reg_score(params)
+
+        return loss
+
+    @functools.cached_property
+    def _step_fn(self):
+        def step(flat_w, state, net_state, features, labels, fmask, lmask):
+            loss = self._flat_loss(net_state, (features, labels, fmask,
+                                               lmask))
+            f0, g = jax.value_and_grad(loss)(flat_w)
+            if self.algo == LBFGS:
+                # fold the completed previous step into the ring buffer
+                state = jax.lax.cond(
+                    state.step_num > 0,
+                    lambda st: _update_lbfgs_history(st, flat_w, g),
+                    lambda st: st, state)
+                direction = _lbfgs_direction(g, state)
+            elif self.algo == CONJUGATE_GRADIENT:
+                direction = jnp.where(state.step_num == 0, -g,
+                                      _cg_direction(g, state))
+            else:
+                direction = -g
+            alpha = backtrack_line_search(
+                loss, flat_w, f0, g, direction,
+                max_iterations=self.max_ls)
+            if self.algo == LINE_GRADIENT_DESCENT:
+                step_vec = alpha * direction
+                used_dir = direction
+            else:
+                # Armijo failed on the curved direction: restart with a
+                # steepest-descent line search (keeps every accepted step
+                # monotone — a fixed-lr fallback can oscillate).  Guarded
+                # by cond so its loss evaluations only run on failure.
+                alpha_sd = jax.lax.cond(
+                    alpha > 0,
+                    lambda: jnp.zeros_like(alpha),
+                    lambda: backtrack_line_search(
+                        loss, flat_w, f0, g, -g,
+                        max_iterations=self.max_ls))
+                ok = alpha > 0
+                step_vec = jnp.where(ok, alpha * direction, -alpha_sd * g)
+                used_dir = jnp.where(ok, direction, -g)
+            new_w = flat_w + step_vec
+            new_state = state._replace(prev_grad=g, prev_dir=used_dir,
+                                       prev_w=flat_w,
+                                       step_num=state.step_num + 1)
+            return new_w, new_state, f0
+
+        return jax.jit(step, donate_argnums=(1,))
+
+
+    def optimize(self, features, labels, fmask, lmask,
+                 iterations: int = 1) -> float:
+        """Run solver iterations on one batch; updates ``net.params`` in
+        place and returns the last pre-step score."""
+        net = self.net
+        flat_w, unravel = ravel_pytree(net.params)
+        self._unravel = unravel
+        if self._state is None or self._state.prev_grad.size != flat_w.size:
+            self._state = init_solver_state(flat_w.size, flat_w.dtype)
+        score = float("nan")
+        for _ in range(iterations):
+            flat_w, self._state, f0 = self._step_fn(
+                flat_w, self._state, net.net_state, features, labels,
+                fmask, lmask)
+            score = f0
+        net.params = unravel(flat_w)
+        return float(score)

@@ -181,6 +181,34 @@ def test_tbptt_back_shorter_than_fwd_trains():
     assert net.score(ds) < s0
 
 
+def test_tbptt_back_shorter_exact_truncation_semantics():
+    """Reference parity (LSTMHelpers truncated backward loop): with
+    back < fwd, leading-step labels still train the output layer (epsilons
+    exist at ALL window steps) but contribute nothing to the recurrent
+    trunk (the LSTM backward iteration stops back steps from the end)."""
+    import numpy as np_
+    rng = np_.random.RandomState(3)
+    X = rng.randn(4, 6, 3)
+    Y = np_.eye(3)[rng.randint(0, 3, (4, 6))]
+    Y2 = Y.copy()
+    Y2[:, :3] = np_.eye(3)[rng.randint(0, 3, (4, 3))]  # change leading only
+
+    def one_step(labels):
+        net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)],
+                   tbptt=6, tbptt_back=3)
+        net.fit(DataSet(X, labels))
+        return net
+
+    a, b = one_step(Y), one_step(Y2)
+    # LSTM params identical: leading-step labels are invisible to the trunk
+    for k in a.params[0]:
+        np.testing.assert_allclose(np.asarray(a.params[0][k]),
+                                   np.asarray(b.params[0][k]), rtol=1e-12)
+    # output layer params differ: its gradient covers all window steps
+    assert not np.allclose(np.asarray(a.params[1]["W"]),
+                           np.asarray(b.params[1]["W"]))
+
+
 def test_tbptt_back_longer_than_fwd_raises():
     ds = _seq_ds()
     net = _net([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3)],

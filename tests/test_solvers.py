@@ -1,0 +1,125 @@
+"""Solver family tests (reference ``TestOptimizers.java``: each
+OptimizationAlgorithm must drive score down on a simple problem, with
+sphere-function style unit checks on the line search)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.iris import iris_dataset
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                    RnnOutputLayer)
+from deeplearning4j_tpu.optimize.solvers import (backtrack_line_search,
+                                                 init_solver_state)
+
+ALGOS = ["line_gradient_descent", "conjugate_gradient", "lbfgs"]
+
+
+def _iris_net(algo, seed=12345):
+    lb = (NeuralNetConfiguration.builder().seed(seed).dtype("float64")
+          .optimization_algo(algo).updater("sgd").learning_rate(0.1)
+          .activation("tanh").weight_init("xavier").list()
+          .layer(DenseLayer(n_in=4, n_out=8))
+          .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                             loss="mcxent")))
+    return MultiLayerNetwork(lb.build()).init()
+
+
+# ------------------------------------------------------------ line search
+def test_backtrack_line_search_quadratic():
+    # f(w) = ||w||^2: from w0 = ones, d = -grad, a full Newton step is 0.5
+    def loss(w):
+        return jnp.sum(w * w)
+
+    w = jnp.ones(5)
+    g = 2.0 * w
+    a = backtrack_line_search(loss, w, loss(w), g, -g, max_iterations=10)
+    assert float(a) > 0
+    assert float(loss(w - float(a) * g)) < float(loss(w))
+
+
+def test_backtrack_line_search_rejects_ascent_direction():
+    def loss(w):
+        return jnp.sum(w * w)
+
+    w = jnp.ones(3)
+    g = 2.0 * w
+    a = backtrack_line_search(loss, w, loss(w), g, +g, max_iterations=10)
+    assert float(a) == 0.0
+
+
+# ------------------------------------------------------------- convergence
+@pytest.mark.parametrize("algo", ALGOS)
+def test_iris_converges(algo):
+    net = _iris_net(algo)
+    ds = iris_dataset()
+    s0 = net.score(ds)
+    net.fit(ds, epochs=60)
+    s1 = net.score(ds)
+    assert s1 < s0 * 0.5, f"{algo}: {s0} -> {s1}"
+    preds = net.predict(ds.features)
+    acc = float(np.mean(preds == np.argmax(np.asarray(ds.labels), axis=1)))
+    assert acc > 0.9, f"{algo}: accuracy {acc}"
+
+
+def test_lbfgs_beats_line_gd_on_iris():
+    """Curvature information must pay off: after the same iteration budget
+    LBFGS reaches a lower loss than plain line-search gradient descent."""
+    ds = iris_dataset()
+    lgd = _iris_net("line_gradient_descent")
+    lbfgs = _iris_net("lbfgs")
+    lgd.fit(ds, epochs=40)
+    lbfgs.fit(ds, epochs=40)
+    assert lbfgs.score(ds) < lgd.score(ds)
+
+
+def test_solver_score_and_iteration_bookkeeping():
+    net = _iris_net("conjugate_gradient")
+    ds = iris_dataset()
+    net.fit(ds, epochs=3)
+    assert net.iteration == 3
+    assert np.isfinite(net.score())
+
+
+# ------------------------------------------------------------------ guards
+def test_unknown_algo_raises():
+    net = _iris_net("newtons_method_of_my_dreams")
+    with pytest.raises(ValueError):
+        net.fit(iris_dataset())
+
+
+def test_solver_with_tbptt_raises():
+    from deeplearning4j_tpu.nn.conf import inputs
+    lb = (NeuralNetConfiguration.builder().seed(1).dtype("float64")
+          .optimization_algo("lbfgs").updater("sgd").learning_rate(0.1)
+          .activation("tanh").weight_init("xavier").list()
+          .layer(GravesLSTM(n_out=4))
+          .layer(RnnOutputLayer(n_out=2)))
+    lb.set_input_type(inputs.recurrent(3, 5))
+    lb.backprop_type("tbptt")
+    net = MultiLayerNetwork(lb.build()).init()
+    rng = np.random.RandomState(0)
+    ds = DataSet(rng.randn(4, 5, 3), np.eye(2)[rng.randint(0, 2, (4, 5))])
+    with pytest.raises(ValueError):
+        net.fit(ds)
+
+
+def test_graph_solver_converges():
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    g = (NeuralNetConfiguration.builder().seed(7).dtype("float64")
+         .optimization_algo("lbfgs").updater("sgd").learning_rate(0.1)
+         .activation("tanh").weight_init("xavier").graph_builder()
+         .add_inputs("in")
+         .add_layer("h", DenseLayer(n_in=4, n_out=8), "in")
+         .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                       activation="softmax",
+                                       loss="mcxent"), "h")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    ds = iris_dataset()
+    s0 = cg.score(ds)
+    cg.fit(ds, epochs=40)
+    assert cg.score(ds) < s0 * 0.5
