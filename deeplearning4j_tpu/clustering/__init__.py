@@ -1,0 +1,12 @@
+"""Clustering tier: k-means, vantage-point tree nearest neighbours.
+
+Reference module: ``deeplearning4j-core/.../clustering/`` (kmeans/
+KMeansClustering.java, vptree/VPTree.java, plus the kdtree/quadtree/sptree
+family whose only consumer is Barnes-Hut t-SNE — replaced here by the
+exact on-device t-SNE gradient, see ``plot/tsne.py``).
+"""
+
+from .kmeans import Cluster, ClusterSet, KMeansClustering
+from .vptree import VPTree
+
+__all__ = ["KMeansClustering", "Cluster", "ClusterSet", "VPTree"]

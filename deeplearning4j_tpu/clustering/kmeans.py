@@ -1,0 +1,171 @@
+"""K-means clustering on the MXU.
+
+Reference: ``deeplearning4j-core/.../clustering/kmeans/KMeansClustering.java``
+(setup(k, maxIter, distanceFn) over the BaseClusteringAlgorithm loop:
+random initial centers, assign-to-nearest, recompute centers, stop on
+iteration budget or convergence) with the ``Cluster``/``ClusterSet``/
+``Point`` surface from ``clustering/cluster/``.
+
+TPU-first redesign: the reference walks points one at a time through a
+strategy/condition object graph.  Here one jitted ``lax.while_loop`` runs
+Lloyd iterations entirely on device — assignment is a single
+(N,D)x(D,K) distance matmul, the center update a one-hot (K,N)x(N,D)
+matmul — so the hot loop is two MXU contractions per iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Cluster:
+    """One cluster (reference ``clustering/cluster/Cluster.java``)."""
+    cluster_id: int
+    center: np.ndarray
+    point_indices: np.ndarray
+
+    def get_center(self) -> np.ndarray:
+        return self.center
+
+    def num_points(self) -> int:
+        return int(self.point_indices.size)
+
+
+class ClusterSet:
+    """Result container (reference ``clustering/cluster/ClusterSet.java``)."""
+
+    def __init__(self, centers: np.ndarray, assignments: np.ndarray,
+                 distance_fn: str):
+        self.centers = centers
+        self.assignments = assignments
+        self.distance_fn = distance_fn
+        self.clusters: List[Cluster] = [
+            Cluster(k, centers[k], np.where(assignments == k)[0])
+            for k in range(centers.shape[0])]
+
+    def get_clusters(self) -> List[Cluster]:
+        return self.clusters
+
+    def cluster_count(self) -> int:
+        return len(self.clusters)
+
+    def nearest_cluster(self, point) -> Cluster:
+        d = _pairwise_sq_dist(np.asarray(point, np.float32)[None, :],
+                              self.centers)[0]
+        if self.distance_fn == "cosinesimilarity":
+            d = -_cosine_sim(np.asarray(point, np.float32)[None, :],
+                             self.centers)[0]
+        return self.clusters[int(np.argmin(d))]
+
+
+def _pairwise_sq_dist(a, b):
+    """||a_i - b_j||^2 via the matmul expansion (one MXU contraction)."""
+    aa = (a * a).sum(-1)[:, None]
+    bb = (b * b).sum(-1)[None, :]
+    return aa + bb - 2.0 * a @ b.T
+
+
+def _cosine_sim(a, b):
+    an = a / np.maximum(np.linalg.norm(a, axis=-1, keepdims=True), 1e-12)
+    bn = b / np.maximum(np.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
+    return an @ bn.T
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _lloyd(points: Array, init_centers: Array, k: int, max_iter: int,
+           cosine: bool) -> tuple:
+    """Full Lloyd loop on device; returns (centers, assignments, iters)."""
+
+    def assign(centers):
+        if cosine:
+            pn = points / jnp.maximum(
+                jnp.linalg.norm(points, axis=-1, keepdims=True), 1e-12)
+            cn = centers / jnp.maximum(
+                jnp.linalg.norm(centers, axis=-1, keepdims=True), 1e-12)
+            return jnp.argmax(pn @ cn.T, axis=1)
+        aa = jnp.sum(points * points, -1)[:, None]
+        cc = jnp.sum(centers * centers, -1)[None, :]
+        return jnp.argmin(aa + cc - 2.0 * points @ centers.T, axis=1)
+
+    def body(state):
+        centers, _, it, _ = state
+        a = assign(centers).astype(jnp.int32)
+        one_hot = jax.nn.one_hot(a, k, dtype=points.dtype)      # (N, K)
+        counts = one_hot.sum(0)                                  # (K,)
+        sums = one_hot.T @ points                                # (K, D)
+        new_centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts[:, None], 1.0),
+                                centers)
+        moved = jnp.max(jnp.sum((new_centers - centers) ** 2, -1))
+        return new_centers, a, it + 1, moved
+
+    def cond(state):
+        _, _, it, moved = state
+        return jnp.logical_and(it < max_iter, moved > 1e-12)
+
+    init = (init_centers, jnp.zeros(points.shape[0], jnp.int32) - 1,
+            jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, points.dtype))
+    centers, _, iters, _ = jax.lax.while_loop(cond, body, init)
+    return centers, assign(centers).astype(jnp.int32), iters
+
+
+class KMeansClustering:
+    """Reference surface: ``KMeansClustering.setup(k, maxIter,
+    distanceFunction)`` then ``applyTo(points)``."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 distance_function: str = "euclidean",
+                 seed: Optional[int] = 0):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.distance_function = distance_function.lower()
+        if self.distance_function not in ("euclidean",
+                                          "cosinesimilarity"):
+            raise ValueError("distance_function must be euclidean or "
+                             "cosinesimilarity")
+        self.seed = seed
+
+    @classmethod
+    def setup(cls, k: int, max_iterations: int = 100,
+              distance_function: str = "euclidean",
+              seed: Optional[int] = 0) -> "KMeansClustering":
+        return cls(k, max_iterations, distance_function, seed)
+
+    def apply_to(self, points) -> ClusterSet:
+        x = np.asarray(points, np.float32)
+        n = x.shape[0]
+        if n < self.k:
+            raise ValueError(f"need at least k={self.k} points, got {n}")
+        rng = np.random.default_rng(self.seed)
+        # k-means++ seeding (host: O(kN), negligible vs the device loop)
+        centers = [x[rng.integers(0, n)]]
+        cosine = self.distance_function == "cosinesimilarity"
+        for _ in range(1, self.k):
+            # seed with the SAME metric that drives the Lloyd loop
+            if cosine:
+                d = np.min(1.0 - _cosine_sim(x, np.stack(centers)), axis=1)
+            else:
+                d = np.min(_pairwise_sq_dist(x, np.stack(centers)), axis=1)
+            d = np.maximum(d, 0.0)  # matmul expansion can go -eps
+            if d.sum() <= 0:        # all points identical: any choice
+                centers.append(x[rng.integers(0, n)])
+                continue
+            centers.append(x[rng.choice(n, p=d / d.sum())])
+        init = jnp.asarray(np.stack(centers))
+        c, a, _ = _lloyd(jnp.asarray(x), init, self.k,
+                         self.max_iterations,
+                         self.distance_function == "cosinesimilarity")
+        return ClusterSet(np.asarray(c), np.asarray(a),
+                          self.distance_fn_name())
+
+    def distance_fn_name(self) -> str:
+        return self.distance_function

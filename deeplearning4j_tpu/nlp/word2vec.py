@@ -176,11 +176,7 @@ class SequenceVectors:
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.RandomState(seed)
         self._code_arrays = None
-        # cross-sequence pair accumulators (see _queue_skipgram)
-        self._sg_queue: list = []
-        self._sg_count = 0
-        self._cb_queue: list = []
-        self._cb_count = 0
+        self._reset_queues()  # cross-sequence pair accumulators
 
     # ----------------------------------------------------------- vocab prep
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> None:

@@ -361,15 +361,28 @@ class MultiLayerNetwork:
                         carries=carries, to_layer=last_rec)
                     trunk = jax.lax.stop_gradient(trunk)
                     mid = jax.lax.stop_gradient(mid)
+                    lmA = None if lm is None else lm[:, :adv]
+                    lmB = None if lm is None else lm[:, adv:]
                     loss_a, _ = self._loss_fn(
-                        p, ns, trunk, l[:, :adv], fmA,
-                        None if lm is None else lm[:, :adv], rA, True,
+                        p, ns, trunk, l[:, :adv], fmA, lmA, rA, True,
                         from_layer=last_rec + 1)
                     loss_b, aux = self._loss_fn(
                         p, ns, f[:, adv:], l[:, adv:],
-                        None if fm is None else fm[:, adv:],
-                        None if lm is None else lm[:, adv:], rB, True,
-                        carries=mid)
+                        None if fm is None else fm[:, adv:], lmB, rB,
+                        True, carries=mid)
+                    # Masked scores normalize by each segment's own mask
+                    # count; recombine so the window averages over the
+                    # TOTAL active steps, matching the adv == 0 path.
+                    eff_a = lmA if lmA is not None else fmA
+                    eff_b = (lmB if lmB is not None
+                             else (None if fm is None else fm[:, adv:]))
+                    if (self.conf.conf.mini_batch and eff_a is not None
+                            and eff_b is not None):
+                        ca = jnp.sum(eff_a)
+                        cb = jnp.sum(eff_b)
+                        total = (loss_a * ca + loss_b * cb) / \
+                            jnp.maximum(ca + cb, 1.0)
+                        return total, aux
                     return loss_a + loss_b, aux
 
                 (data_loss, (new_state, new_carries)), grads = \

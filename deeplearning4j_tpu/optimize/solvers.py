@@ -215,10 +215,18 @@ class Solver:
 
     @functools.cached_property
     def _step_fn(self):
-        def step(flat_w, state, net_state, features, labels, fmask, lmask):
+        def step(flat_w, state, net_state, base_rng, features, labels,
+                 fmask, lmask):
             loss = self._flat_loss(net_state, (features, labels, fmask,
                                                lmask))
             f0, g = jax.value_and_grad(loss)(flat_w)
+            # Scale-invariant start for steepest-descent searches: a unit
+            # step along a huge raw gradient overshoots past every
+            # backtrack level (reference BackTrackLineSearch rescales the
+            # direction above stepMax the same way).
+            sd_init = jnp.minimum(
+                jnp.asarray(1.0, flat_w.dtype),
+                1.0 / jnp.maximum(jnp.linalg.norm(g), 1e-12))
             if self.algo == LBFGS:
                 # fold the completed previous step into the ring buffer
                 state = jax.lax.cond(
@@ -231,13 +239,16 @@ class Solver:
                                       _cg_direction(g, state))
             else:
                 direction = -g
-            alpha = backtrack_line_search(
-                loss, flat_w, f0, g, direction,
-                max_iterations=self.max_ls)
             if self.algo == LINE_GRADIENT_DESCENT:
+                alpha = backtrack_line_search(
+                    loss, flat_w, f0, g, direction,
+                    max_iterations=self.max_ls, initial_step=sd_init)
                 step_vec = alpha * direction
                 used_dir = direction
             else:
+                alpha = backtrack_line_search(
+                    loss, flat_w, f0, g, direction,
+                    max_iterations=self.max_ls)
                 # Armijo failed on the curved direction: restart with a
                 # steepest-descent line search (keeps every accepted step
                 # monotone — a fixed-lr fallback can oscillate).  Guarded
@@ -247,7 +258,8 @@ class Solver:
                     lambda: jnp.zeros_like(alpha),
                     lambda: backtrack_line_search(
                         loss, flat_w, f0, g, -g,
-                        max_iterations=self.max_ls))
+                        max_iterations=self.max_ls,
+                        initial_step=sd_init))
                 ok = alpha > 0
                 step_vec = jnp.where(ok, alpha * direction, -alpha_sd * g)
                 used_dir = jnp.where(ok, direction, -g)
@@ -255,7 +267,15 @@ class Solver:
             new_state = state._replace(prev_grad=g, prev_dir=used_dir,
                                        prev_w=flat_w,
                                        step_num=state.step_num + 1)
-            return new_w, new_state, f0
+            # refresh stateful-layer statistics (BN running mean/var) with
+            # one train-mode forward at the accepted parameters — the SGD
+            # path updates them every step; frozen stats would silently
+            # degrade batch-norm under the solver family
+            rng = jax.random.fold_in(base_rng, state.step_num)
+            _, (refreshed_state, _) = self.net._loss_fn(
+                self._unravel(new_w), net_state, features, labels, fmask,
+                lmask, rng, True)
+            return new_w, new_state, f0, refreshed_state
 
         return jax.jit(step, donate_argnums=(1,))
 
@@ -271,9 +291,9 @@ class Solver:
             self._state = init_solver_state(flat_w.size, flat_w.dtype)
         score = float("nan")
         for _ in range(iterations):
-            flat_w, self._state, f0 = self._step_fn(
-                flat_w, self._state, net.net_state, features, labels,
-                fmask, lmask)
+            flat_w, self._state, f0, net.net_state = self._step_fn(
+                flat_w, self._state, net.net_state, net._rng_key,
+                features, labels, fmask, lmask)
             score = f0
         net.params = unravel(flat_w)
         return float(score)

@@ -1,0 +1,9 @@
+"""Plot/visualization tier: t-SNE dimensionality reduction.
+
+Reference module: ``deeplearning4j-core/.../plot/`` (``BarnesHutTsne.java``
++ its quadtree/sptree support structures).
+"""
+
+from .tsne import Tsne
+
+__all__ = ["Tsne"]

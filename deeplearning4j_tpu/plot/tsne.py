@@ -1,0 +1,214 @@
+"""t-SNE embedding (van der Maaten & Hinton 2008).
+
+Reference: ``deeplearning4j-core/.../plot/BarnesHutTsne.java:848`` — the
+Builder surface (perplexity, theta, learningRate, maxIter/numIterations,
+momentum switch, early exaggeration), per-point sigma search to match the
+target perplexity (``computeGaussianPerplexity``), gradient loop with
+momentum + per-dimension gains, and ``saveCoordinates`` output.
+
+TPU-first redesign: the reference approximates the repulsive force with a
+Barnes-Hut quadtree/sptree (theta > 0) because exact t-SNE is O(N²) on a
+CPU.  On TPU the exact N² affinity and gradient are a handful of MXU
+matmuls — faster than any host-side tree walk for the N this API is used
+at (embedding visualisations, ≤ tens of thousands of points) — so
+``theta`` is accepted for surface parity but the computation is always
+exact.  The entire optimisation (sigma bisection, P matrix, every
+gradient iteration with momentum/gains/exaggeration) runs in ONE jitted
+``lax.fori_loop`` program; nothing crosses the host boundary until the
+final coordinates.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _sq_dists(x: Array) -> Array:
+    n2 = jnp.sum(x * x, axis=1)
+    d = n2[:, None] + n2[None, :] - 2.0 * x @ x.T
+    return jnp.maximum(d, 0.0)
+
+
+def _cond_probs(d_row: Array, beta: Array, i_mask: Array) -> Array:
+    """p_{j|i} for one precision beta, self-probability masked to 0."""
+    p = jnp.exp(-d_row * beta) * i_mask
+    return p / jnp.maximum(p.sum(), 1e-12)
+
+
+def _perplexity_search(d: Array, target_entropy: float,
+                       iters: int = 50) -> Array:
+    """Vectorised per-point bisection on beta = 1/(2 sigma^2) so each
+    row's Shannon entropy matches log(perplexity) (reference
+    ``computeGaussianPerplexity`` binary search, all rows at once)."""
+    n = d.shape[0]
+    eye_mask = 1.0 - jnp.eye(n, dtype=d.dtype)
+
+    def entropy(beta):
+        p = jnp.exp(-d * beta[:, None]) * eye_mask
+        psum = jnp.maximum(p.sum(1), 1e-12)
+        # H = log(sum) + beta * sum(d * p)/sum(p)
+        return jnp.log(psum) + beta * jnp.sum(d * p, 1) / psum
+
+    def body(_, state):
+        beta, lo, hi = state
+        h = entropy(beta)
+        too_high = h > target_entropy          # entropy too big -> raise beta
+        new_lo = jnp.where(too_high, beta, lo)
+        new_hi = jnp.where(too_high, hi, beta)
+        new_beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(new_hi), beta * 2.0,
+                      (beta + new_hi) / 2.0),
+            (new_lo + beta) / 2.0)
+        return new_beta, new_lo, new_hi
+
+    beta0 = jnp.ones(n, d.dtype)
+    lo0 = jnp.zeros(n, d.dtype)
+    hi0 = jnp.full(n, jnp.inf, d.dtype)
+    beta, _, _ = jax.lax.fori_loop(0, iters, body, (beta0, lo0, hi0))
+    return beta
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _tsne_run(x: Array, key: Array, n_dims: int, perplexity: float,
+              max_iter: int, learning_rate: float, switch_momentum: int,
+              stop_lying_iteration: int, exaggeration: float):
+    """Whole t-SNE optimisation as one XLA program."""
+    n = x.shape[0]
+    d = _sq_dists(x)
+    beta = _perplexity_search(d, jnp.log(perplexity))
+    eye_mask = 1.0 - jnp.eye(n, dtype=x.dtype)
+    p = jnp.exp(-d * beta[:, None]) * eye_mask
+    p = p / jnp.maximum(p.sum(1, keepdims=True), 1e-12)
+    p = (p + p.T) / (2.0 * n)                      # symmetrize
+    p = jnp.maximum(p, 1e-12)
+
+    y0 = jax.random.normal(key, (n, n_dims), x.dtype) * 1e-2
+
+    def grad_kl(y, p_eff):
+        dy = _sq_dists(y)
+        num = eye_mask / (1.0 + dy)                # student-t kernel
+        q = num / jnp.maximum(num.sum(), 1e-12)
+        q = jnp.maximum(q, 1e-12)
+        w = (p_eff - q) * num                      # (N, N)
+        # dC/dy_i = 4 sum_j w_ij (y_i - y_j)  -> two matmul-shaped ops
+        g = 4.0 * (jnp.diag(w.sum(1)) - w) @ y
+        kl = jnp.sum(p_eff * jnp.log(p_eff / q))
+        return g, kl
+
+    def body(it, state):
+        y, vel, gains = state
+        momentum = jnp.where(it < switch_momentum, 0.5, 0.8)
+        lying = it < stop_lying_iteration
+        p_eff = jnp.where(lying, p * exaggeration, p)
+        g, _ = grad_kl(y, p_eff)
+        # per-dimension gains (reference BarnesHutTsne gains update)
+        same_sign = jnp.sign(g) == jnp.sign(vel)
+        gains = jnp.where(same_sign, gains * 0.8, gains + 0.2)
+        gains = jnp.maximum(gains, 0.01)
+        vel = momentum * vel - learning_rate * gains * g
+        y = y + vel
+        y = y - y.mean(0, keepdims=True)           # recenter
+        return y, vel, gains
+
+    y, _, _ = jax.lax.fori_loop(
+        0, max_iter, body,
+        (y0, jnp.zeros_like(y0), jnp.ones_like(y0)))
+    _, kl = grad_kl(y, p)
+    return y, kl
+
+
+class Tsne:
+    """Reference ``BarnesHutTsne`` Builder surface; exact computation
+    (``theta`` accepted but ignored — see module docstring)."""
+
+    def __init__(self, n_dims: int = 2, perplexity: float = 30.0,
+                 theta: float = 0.5, learning_rate: float = 200.0,
+                 max_iter: int = 1000, switch_momentum_iteration: int = 250,
+                 stop_lying_iteration: int = 250,
+                 exaggeration: float = 12.0, seed: int = 42,
+                 normalize: bool = True):
+        self.n_dims = n_dims
+        self.perplexity = perplexity
+        self.theta = theta
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.exaggeration = exaggeration
+        self.seed = seed
+        self.normalize = normalize
+        self.coords: Optional[np.ndarray] = None
+        self.kl_divergence: float = float("nan")
+
+    class Builder:
+        """Reference ``BarnesHutTsne.Builder`` fluent surface: any
+        constructor parameter as a chainable setter (``set_max_iter`` maps
+        to ``max_iter``; unknown knobs from the reference surface, e.g.
+        ``use_pca``, are accepted and ignored)."""
+
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+
+            def setter(value):
+                key = name[4:] if name.startswith("set_") else name
+                self._kw[key] = value
+                return self
+            return setter
+
+        def build(self) -> "Tsne":
+            import inspect
+            valid = set(inspect.signature(Tsne.__init__).parameters)
+            return Tsne(**{k: v for k, v in self._kw.items()
+                           if k in valid})
+
+    def fit(self, x) -> "Tsne":
+        """Embed (reference ``BarnesHutTsne.fit``); coordinates land in
+        ``.coords`` / ``get_coordinates()``."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[0] < 2:
+            raise ValueError("fit expects an (n>=2, d) matrix")
+        if self.perplexity * 3.0 > x.shape[0] - 1:
+            raise ValueError(
+                f"perplexity {self.perplexity} too large for n={x.shape[0]}"
+                " (need n-1 >= 3*perplexity)")
+        if self.normalize:
+            x = (x - x.mean(0)) / np.maximum(x.std(0), 1e-12)
+        y, kl = _tsne_run(
+            jnp.asarray(x), jax.random.PRNGKey(self.seed), self.n_dims,
+            float(self.perplexity), int(self.max_iter),
+            float(self.learning_rate), int(self.switch_momentum_iteration),
+            int(self.stop_lying_iteration), float(self.exaggeration))
+        self.coords = np.asarray(y)
+        self.kl_divergence = float(kl)
+        return self
+
+    def fit_transform(self, x) -> np.ndarray:
+        return self.fit(x).get_coordinates()
+
+    def get_coordinates(self) -> np.ndarray:
+        if self.coords is None:
+            raise RuntimeError("call fit() first")
+        return self.coords
+
+    def save_coordinates(self, path: str, labels=None) -> None:
+        """CSV of embedded coordinates, one row per point with optional
+        trailing label (reference ``BarnesHutTsne.saveCoordsForPlot``)."""
+        coords = self.get_coordinates()
+        with open(path, "w", encoding="utf-8") as f:
+            for i in range(coords.shape[0]):
+                row = [f"{v:.6f}" for v in coords[i]]
+                if labels is not None:
+                    row.append(str(labels[i]))
+                f.write(",".join(row) + "\n")

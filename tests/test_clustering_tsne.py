@@ -1,0 +1,131 @@
+"""Clustering + t-SNE tests.
+
+Mirrors the reference tests: ``KMeansTest`` (clusters recover well-
+separated blobs), ``VpTreeNodeTest`` (kNN matches brute force),
+``BarnesHutTsneTest`` (embedding runs, finite coords, neighbours stay
+together).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KMeansClustering, VPTree
+from deeplearning4j_tpu.plot import Tsne
+
+
+def _blobs(k=3, per=30, d=4, spread=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 5.0
+    x = np.concatenate([centers[i] + spread * rng.randn(per, d)
+                        for i in range(k)])
+    labels = np.repeat(np.arange(k), per)
+    return x.astype(np.float32), labels, centers
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, labels, _ = _blobs()
+        cs = KMeansClustering.setup(3, 100, "euclidean").apply_to(x)
+        assert cs.cluster_count() == 3
+        # every true blob maps to exactly one predicted cluster
+        for t in range(3):
+            pred = cs.assignments[labels == t]
+            assert len(set(pred.tolist())) == 1
+        # and the mapping is a bijection
+        assert len(set(cs.assignments.tolist())) == 3
+
+    def test_centers_near_truth(self):
+        x, labels, centers = _blobs(spread=0.1, seed=3)
+        cs = KMeansClustering.setup(3, 100).apply_to(x)
+        for t in range(3):
+            d = np.linalg.norm(cs.centers - centers[t], axis=1).min()
+            assert d < 0.5
+
+    def test_nearest_cluster(self):
+        x, labels, centers = _blobs(seed=5)
+        cs = KMeansClustering.setup(3, 100).apply_to(x)
+        cl = cs.nearest_cluster(centers[0])
+        member_labels = labels[cl.point_indices]
+        assert (member_labels == 0).all()
+
+    def test_cosine_distance(self):
+        rng = np.random.RandomState(2)
+        # two directions, different magnitudes
+        a = rng.rand(20, 1) * np.array([[1.0, 0.1, 0.0]])
+        b = rng.rand(20, 1) * np.array([[0.0, 0.1, 1.0]])
+        x = np.concatenate([a, b]).astype(np.float32)
+        cs = KMeansClustering.setup(2, 50, "cosinesimilarity").apply_to(x)
+        assert len(set(cs.assignments[:20].tolist())) == 1
+        assert len(set(cs.assignments[20:].tolist())) == 1
+        assert cs.assignments[0] != cs.assignments[20]
+
+    def test_too_few_points_raises(self):
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(5).apply_to(np.zeros((3, 2)))
+
+
+class TestVPTree:
+    def test_knn_matches_brute_force(self):
+        rng = np.random.RandomState(1)
+        pts = rng.randn(200, 6).astype(np.float32)
+        tree = VPTree(pts)
+        for qi in (0, 17, 99):
+            q = pts[qi] + 0.01
+            idx, dist = tree.knn(q, k=5)
+            brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+            np.testing.assert_array_equal(np.sort(idx), np.sort(brute))
+            assert (np.diff(dist) >= -1e-6).all()  # sorted ascending
+
+    def test_cosine_knn(self):
+        pts = np.array([[1, 0], [0.9, 0.1], [0, 1], [-1, 0]], np.float32)
+        tree = VPTree(pts, distance="cosine")
+        idx, _ = tree.knn(np.array([1.0, 0.05]), k=2)
+        assert set(idx.tolist()) == {0, 1}
+
+    def test_single_point(self):
+        tree = VPTree(np.zeros((1, 3)))
+        idx, dist = tree.knn(np.ones(3), k=1)
+        assert idx.tolist() == [0]
+
+
+class TestTsne:
+    def test_embedding_separates_blobs(self):
+        x, labels, _ = _blobs(k=3, per=25, d=8, spread=0.2, seed=7)
+        t = Tsne(n_dims=2, perplexity=10.0, max_iter=300,
+                 learning_rate=100.0, seed=1)
+        y = t.fit_transform(x)
+        assert y.shape == (75, 2)
+        assert np.isfinite(y).all()
+        assert np.isfinite(t.kl_divergence)
+
+    def test_blob_cohesion(self):
+        x, labels, _ = _blobs(k=2, per=25, d=6, spread=0.2, seed=9)
+        y = Tsne(n_dims=2, perplexity=8.0, max_iter=300, seed=2,
+                 learning_rate=100.0, stop_lying_iteration=100,
+                 switch_momentum_iteration=100).fit_transform(x)
+        d_in, d_cross = [], []
+        for i in range(50):
+            for j in range(i + 1, 50):
+                dd = np.linalg.norm(y[i] - y[j])
+                (d_in if labels[i] == labels[j] else d_cross).append(dd)
+        assert np.mean(d_in) < 0.5 * np.mean(d_cross)
+
+    def test_builder_surface(self):
+        t = (Tsne.Builder().set_max_iter(123).perplexity(5.0)
+             .theta(0.5).use_pca(False).learning_rate(50.0).build())
+        assert t.max_iter == 123
+        assert t.perplexity == 5.0
+
+    def test_perplexity_guard(self):
+        with pytest.raises(ValueError):
+            Tsne(perplexity=30.0).fit(np.random.randn(10, 3))
+
+    def test_save_coordinates(self, tmp_path):
+        x, labels, _ = _blobs(k=2, per=15, d=4)
+        t = Tsne(perplexity=5.0, max_iter=50, seed=0)
+        t.fit(x)
+        p = tmp_path / "coords.csv"
+        t.save_coordinates(str(p), labels=labels)
+        lines = p.read_text().strip().split("\n")
+        assert len(lines) == 30
+        assert lines[0].count(",") == 2  # x, y, label
