@@ -63,21 +63,37 @@ class VPTree:
     # -- build -------------------------------------------------------------
 
     def _build(self, indices: List[int]) -> Optional[_Node]:
+        """Iterative construction (explicit worklist): recursion depth
+        would be O(n) on duplicate-heavy data — every tie falls inside a
+        zero-median ball — and blow the interpreter stack."""
         if not indices:
             return None
-        vp_pos = int(self._rng.integers(0, len(indices)))
-        vp = indices.pop(vp_pos)
-        node = _Node(vp)
-        if not indices:
-            return node
-        d = self._dist_many(self.items[vp], indices)
-        median = float(np.median(d))
-        node.threshold = median
-        inside = [i for i, di in zip(indices, d) if di <= median]
-        outside = [i for i, di in zip(indices, d) if di > median]
-        node.inside = self._build(inside)
-        node.outside = self._build(outside)
-        return node
+        root = _Node(-1)
+        work = [(root, "inside", indices)]
+        while work:
+            parent, side, idx = work.pop()
+            vp_pos = int(self._rng.integers(0, len(idx)))
+            vp = idx[vp_pos]
+            rest = idx[:vp_pos] + idx[vp_pos + 1:]
+            node = _Node(vp)
+            setattr(parent, side, node)
+            if not rest:
+                continue
+            d = self._dist_many(self.items[vp], rest)
+            median = float(np.median(d))
+            node.threshold = median
+            inside = [i for i, di in zip(rest, d) if di <= median]
+            outside = [i for i, di in zip(rest, d) if di > median]
+            if not outside and len(inside) > 1:
+                # all ties (e.g. identical points): split arbitrarily so
+                # the tree stays balanced instead of degenerating
+                half = len(inside) // 2
+                inside, outside = inside[:half], inside[half:]
+            if inside:
+                work.append((node, "inside", inside))
+            if outside:
+                work.append((node, "outside", outside))
+        return root.inside
 
     # -- search ------------------------------------------------------------
 

@@ -239,16 +239,15 @@ class Solver:
                                       _cg_direction(g, state))
             else:
                 direction = -g
+            primary_init = (sd_init if self.algo == LINE_GRADIENT_DESCENT
+                            else 1.0)
+            alpha = backtrack_line_search(
+                loss, flat_w, f0, g, direction,
+                max_iterations=self.max_ls, initial_step=primary_init)
             if self.algo == LINE_GRADIENT_DESCENT:
-                alpha = backtrack_line_search(
-                    loss, flat_w, f0, g, direction,
-                    max_iterations=self.max_ls, initial_step=sd_init)
                 step_vec = alpha * direction
                 used_dir = direction
             else:
-                alpha = backtrack_line_search(
-                    loss, flat_w, f0, g, direction,
-                    max_iterations=self.max_ls)
                 # Armijo failed on the curved direction: restart with a
                 # steepest-descent line search (keeps every accepted step
                 # monotone — a fixed-lr fallback can oscillate).  Guarded

@@ -76,10 +76,13 @@ def _perplexity_search(d: Array, target_entropy: float,
     return beta
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
-def _tsne_run(x: Array, key: Array, n_dims: int, perplexity: float,
-              max_iter: int, learning_rate: float, switch_momentum: int,
-              stop_lying_iteration: int, exaggeration: float):
+# Only shape-determining knobs are jit-static (n_dims, max_iter); the
+# scalar hyperparameters stay traced so a perplexity/lr sweep reuses one
+# compiled program instead of recompiling the O(N^2) loop per value.
+@functools.partial(jax.jit, static_argnums=(2, 4))
+def _tsne_run(x: Array, key: Array, n_dims: int, perplexity,
+              max_iter: int, learning_rate, switch_momentum,
+              stop_lying_iteration, exaggeration):
     """Whole t-SNE optimisation as one XLA program."""
     n = x.shape[0]
     d = _sq_dists(x)
@@ -187,9 +190,11 @@ class Tsne:
             x = (x - x.mean(0)) / np.maximum(x.std(0), 1e-12)
         y, kl = _tsne_run(
             jnp.asarray(x), jax.random.PRNGKey(self.seed), self.n_dims,
-            float(self.perplexity), int(self.max_iter),
-            float(self.learning_rate), int(self.switch_momentum_iteration),
-            int(self.stop_lying_iteration), float(self.exaggeration))
+            jnp.float32(self.perplexity), int(self.max_iter),
+            jnp.float32(self.learning_rate),
+            jnp.int32(self.switch_momentum_iteration),
+            jnp.int32(self.stop_lying_iteration),
+            jnp.float32(self.exaggeration))
         self.coords = np.asarray(y)
         self.kl_divergence = float(kl)
         return self

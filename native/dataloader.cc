@@ -1,0 +1,265 @@
+// Native data-loader core: binary dataset decode + threaded prefetch ring.
+//
+// Role (SURVEY.md §2.11): the reference's ETL bottoms out in native code —
+// JavaCPP-wrapped readers under datavec and the ND4J buffer machinery —
+// and its training loop hides host latency behind AsyncDataSetIterator's
+// prefetch thread.  This file is the TPU build's C++ equivalent: IDX
+// (MNIST, reference MnistManager/MnistImageFile layout) and CIFAR-10
+// binary-batch decode straight into float32, plus a pthread ring buffer
+// that shuffles + gathers minibatches off the Python thread entirely.
+//
+// Consumed from Python via ctypes (see deeplearning4j_tpu/nativeops).
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <vector>
+
+namespace {
+
+uint32_t read_be32(const unsigned char* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+// xorshift PRNG — deterministic shuffles without libc rand() state
+struct XorShift {
+  uint64_t s;
+  explicit XorShift(uint64_t seed) : s(seed ? seed : 0x9E3779B97F4A7C15ULL) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+};
+
+std::vector<unsigned char> read_file(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (f == nullptr) return {};
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<unsigned char> buf((size_t)size);
+  size_t got = fread(buf.data(), 1, (size_t)size, f);
+  fclose(f);
+  buf.resize(got);
+  return buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------------- IDX
+
+// Parse an IDX header: fills dims[0..ndim) and returns ndim (<=4), or -1.
+// Magic: 0x00000801 (labels, u8 rank1) / 0x00000803 (images, u8 rank3).
+int dl4j_idx_info(const char* path, int64_t* dims, int max_dims) {
+  std::vector<unsigned char> buf = read_file(path);
+  if (buf.size() < 4) return -1;
+  uint32_t magic = read_be32(buf.data());
+  if ((magic & 0xFFFFFF00) != 0x00000800) return -1;
+  int ndim = (int)(magic & 0xFF);
+  if (ndim > max_dims || buf.size() < 4 + 4 * (size_t)ndim) return -1;
+  for (int i = 0; i < ndim; ++i) {
+    dims[i] = (int64_t)read_be32(buf.data() + 4 + 4 * i);
+  }
+  return ndim;
+}
+
+// Decode IDX u8 payload to float32 (optionally /255).  Returns elements
+// written, or -1 on parse failure / short output buffer.
+int64_t dl4j_idx_decode(const char* path, float* out, int64_t max_elems,
+                        int normalize) {
+  int64_t dims[4];
+  int ndim = dl4j_idx_info(path, dims, 4);
+  if (ndim < 0) return -1;
+  int64_t total = 1;
+  for (int i = 0; i < ndim; ++i) total *= dims[i];
+  if (total > max_elems) return -1;
+  std::vector<unsigned char> buf = read_file(path);
+  size_t offset = 4 + 4 * (size_t)ndim;
+  if (buf.size() < offset + (size_t)total) return -1;
+  const float scale = normalize ? (1.0f / 255.0f) : 1.0f;
+  const unsigned char* src = buf.data() + offset;
+  for (int64_t i = 0; i < total; ++i) out[i] = scale * (float)src[i];
+  return total;
+}
+
+// ----------------------------------------------------------------- CIFAR
+
+// Decode CIFAR-10 binary batches: records of [label u8][3072 u8 planar
+// RGB].  Images come out NHWC float32 in [0,1] (TPU conv layout); labels
+// as int32.  Returns records decoded, or -1.
+int64_t dl4j_cifar_decode(const char* path, float* images, int32_t* labels,
+                          int64_t max_records) {
+  const int64_t kRec = 1 + 3 * 32 * 32;
+  std::vector<unsigned char> buf = read_file(path);
+  if (buf.empty()) return -1;
+  int64_t n = (int64_t)(buf.size() / (size_t)kRec);
+  if (n > max_records) n = max_records;
+  for (int64_t r = 0; r < n; ++r) {
+    const unsigned char* rec = buf.data() + r * kRec;
+    labels[r] = (int32_t)rec[0];
+    const unsigned char* px = rec + 1;
+    float* img = images + r * 32 * 32 * 3;
+    for (int c = 0; c < 3; ++c) {
+      for (int y = 0; y < 32; ++y) {
+        for (int x = 0; x < 32; ++x) {
+          // planar (C, H, W) -> NHWC
+          img[(y * 32 + x) * 3 + c] =
+              (float)px[(c * 32 + y) * 32 + x] / 255.0f;
+        }
+      }
+    }
+  }
+  return n;
+}
+
+// -------------------------------------------------------- prefetch ring
+
+// Background-thread minibatch prefetcher over an in-memory dataset:
+// per-epoch Fisher-Yates shuffle, batch gather into a bounded ring of
+// slots, consumer copies out.  The producer runs entirely off the
+// GIL/Python thread (reference AsyncDataSetIterator role, natively).
+struct Prefetcher {
+  const float* features;  // (n, feat_dim) borrowed from caller
+  const float* labels;    // (n, label_dim)
+  int64_t n, feat_dim, label_dim, batch;
+  int capacity;
+  uint64_t seed;
+
+  std::vector<float> slots_f, slots_l;  // capacity x batch x dim
+  std::vector<int> ready;               // slot states (0 empty, 1 full)
+  int head = 0, tail = 0, count = 0;
+  bool stop = false;
+  pthread_mutex_t mu;
+  pthread_cond_t not_full, not_empty;
+  pthread_t thread;
+};
+
+static void* prefetch_worker(void* arg) {
+  Prefetcher* p = static_cast<Prefetcher*>(arg);
+  std::vector<int64_t> order(p->n);
+  for (int64_t i = 0; i < p->n; ++i) order[i] = i;
+  XorShift rng(p->seed);
+  int64_t pos = p->n;  // trigger shuffle on first batch
+  while (true) {
+    // assemble the next batch into a scratch gather outside the lock
+    if (pos + p->batch > p->n) {
+      for (int64_t i = p->n - 1; i > 0; --i) {
+        int64_t j = (int64_t)(rng.next() % (uint64_t)(i + 1));
+        int64_t tmp = order[i];
+        order[i] = order[j];
+        order[j] = tmp;
+      }
+      pos = 0;
+    }
+    pthread_mutex_lock(&p->mu);
+    while (p->count == p->capacity && !p->stop) {
+      pthread_cond_wait(&p->not_full, &p->mu);
+    }
+    if (p->stop) {
+      pthread_mutex_unlock(&p->mu);
+      return nullptr;
+    }
+    int slot = p->tail;
+    pthread_mutex_unlock(&p->mu);
+
+    float* fdst = p->slots_f.data() + (size_t)slot * p->batch * p->feat_dim;
+    float* ldst = p->slots_l.data() + (size_t)slot * p->batch * p->label_dim;
+    for (int64_t b = 0; b < p->batch; ++b) {
+      int64_t src = order[pos + b];
+      memcpy(fdst + b * p->feat_dim, p->features + src * p->feat_dim,
+             (size_t)p->feat_dim * sizeof(float));
+      memcpy(ldst + b * p->label_dim, p->labels + src * p->label_dim,
+             (size_t)p->label_dim * sizeof(float));
+    }
+    pos += p->batch;
+
+    pthread_mutex_lock(&p->mu);
+    p->ready[slot] = 1;
+    p->tail = (p->tail + 1) % p->capacity;
+    p->count++;
+    pthread_cond_signal(&p->not_empty);
+    pthread_mutex_unlock(&p->mu);
+  }
+}
+
+void* dl4j_prefetcher_create(const float* features, const float* labels,
+                             int64_t n, int64_t feat_dim,
+                             int64_t label_dim, int64_t batch,
+                             int capacity, uint64_t seed) {
+  if (n <= 0 || batch <= 0 || batch > n || capacity <= 0) return nullptr;
+  Prefetcher* p = new Prefetcher();
+  p->features = features;
+  p->labels = labels;
+  p->n = n;
+  p->feat_dim = feat_dim;
+  p->label_dim = label_dim;
+  p->batch = batch;
+  p->capacity = capacity;
+  p->seed = seed;
+  p->slots_f.resize((size_t)capacity * batch * feat_dim);
+  p->slots_l.resize((size_t)capacity * batch * label_dim);
+  p->ready.assign(capacity, 0);
+  pthread_mutex_init(&p->mu, nullptr);
+  pthread_cond_init(&p->not_full, nullptr);
+  pthread_cond_init(&p->not_empty, nullptr);
+  if (pthread_create(&p->thread, nullptr, prefetch_worker, p) != 0) {
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+// Blocks until a batch is ready; copies it into feat_out/label_out.
+// Returns 0, or -1 if the prefetcher is stopped.
+int dl4j_prefetcher_next(void* handle, float* feat_out, float* label_out) {
+  Prefetcher* p = static_cast<Prefetcher*>(handle);
+  pthread_mutex_lock(&p->mu);
+  while (p->count == 0 && !p->stop) {
+    pthread_cond_wait(&p->not_empty, &p->mu);
+  }
+  if (p->stop && p->count == 0) {
+    pthread_mutex_unlock(&p->mu);
+    return -1;
+  }
+  int slot = p->head;
+  pthread_mutex_unlock(&p->mu);
+
+  memcpy(feat_out, p->slots_f.data() + (size_t)slot * p->batch * p->feat_dim,
+         (size_t)p->batch * p->feat_dim * sizeof(float));
+  memcpy(label_out,
+         p->slots_l.data() + (size_t)slot * p->batch * p->label_dim,
+         (size_t)p->batch * p->label_dim * sizeof(float));
+
+  pthread_mutex_lock(&p->mu);
+  p->ready[slot] = 0;
+  p->head = (p->head + 1) % p->capacity;
+  p->count--;
+  pthread_cond_signal(&p->not_full);
+  pthread_mutex_unlock(&p->mu);
+  return 0;
+}
+
+void dl4j_prefetcher_destroy(void* handle) {
+  if (handle == nullptr) return;
+  Prefetcher* p = static_cast<Prefetcher*>(handle);
+  pthread_mutex_lock(&p->mu);
+  p->stop = true;
+  pthread_cond_broadcast(&p->not_full);
+  pthread_cond_broadcast(&p->not_empty);
+  pthread_mutex_unlock(&p->mu);
+  pthread_join(p->thread, nullptr);
+  pthread_mutex_destroy(&p->mu);
+  pthread_cond_destroy(&p->not_full);
+  pthread_cond_destroy(&p->not_empty);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,374 @@
+// PJRT C API client shim: the framework's native runtime binding.
+//
+// Role (SURVEY.md §2.11): the reference's native tier is the ND4J C++
+// backend loaded over JavaCPP (external nd4j-native / nd4j-cuda modules,
+// reference pom.xml:125-160).  The TPU-native equivalent binds the PJRT
+// C API: dlopen a PJRT plugin (libtpu / the axon tunnel plugin / a CPU
+// plugin), create a client, enumerate devices, and compile + execute
+// StableHLO programs — C++ talking to the accelerator with no Python in
+// the path.
+//
+// Exposed as a small C ABI consumed from Python via ctypes (pybind11 is
+// not in the image).  All PJRT structs are zero-initialised and sized
+// with the *_STRUCT_SIZE traits so the shim stays forward-compatible
+// with plugins implementing newer minor versions of the API.
+
+#include <dlfcn.h>
+#include <string.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct ShimClient {
+  void* dl_handle = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+};
+
+// Copy a PJRT_Error message into err_buf and destroy the error.
+void consume_error(const PJRT_Api* api, PJRT_Error* error, char* err_buf,
+                   int err_len) {
+  if (error == nullptr) return;
+  PJRT_Error_Message_Args msg_args;
+  memset(&msg_args, 0, sizeof(msg_args));
+  msg_args.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg_args.error = error;
+  api->PJRT_Error_Message(&msg_args);
+  if (err_buf != nullptr && err_len > 0) {
+    size_t n = msg_args.message_size < (size_t)(err_len - 1)
+                   ? msg_args.message_size
+                   : (size_t)(err_len - 1);
+    memcpy(err_buf, msg_args.message, n);
+    err_buf[n] = '\0';
+  }
+  PJRT_Error_Destroy_Args destroy_args;
+  memset(&destroy_args, 0, sizeof(destroy_args));
+  destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  destroy_args.error = error;
+  api->PJRT_Error_Destroy(&destroy_args);
+}
+
+void set_err(char* err_buf, int err_len, const char* msg) {
+  if (err_buf != nullptr && err_len > 0) {
+    snprintf(err_buf, err_len, "%s", msg);
+  }
+}
+
+bool await_event(const PJRT_Api* api, PJRT_Event* event, char* err_buf,
+                 int err_len) {
+  if (event == nullptr) return true;
+  PJRT_Event_Await_Args await_args;
+  memset(&await_args, 0, sizeof(await_args));
+  await_args.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  await_args.event = event;
+  PJRT_Error* error = api->PJRT_Event_Await(&await_args);
+  bool ok = error == nullptr;
+  if (!ok) consume_error(api, error, err_buf, err_len);
+  PJRT_Event_Destroy_Args destroy_args;
+  memset(&destroy_args, 0, sizeof(destroy_args));
+  destroy_args.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  destroy_args.event = event;
+  api->PJRT_Event_Destroy(&destroy_args);
+  return ok;
+}
+
+std::vector<PJRT_Device*> addressable_devices(ShimClient* shim) {
+  PJRT_Client_AddressableDevices_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  args.client = shim->client;
+  PJRT_Error* error =
+      shim->api->PJRT_Client_AddressableDevices(&args);
+  if (error != nullptr) {
+    consume_error(shim->api, error, nullptr, 0);
+    return {};
+  }
+  return std::vector<PJRT_Device*>(
+      args.addressable_devices,
+      args.addressable_devices + args.num_addressable_devices);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Load a PJRT plugin and create a client with named creation options
+// (the PJRT_NamedValue list plugins like the axon tunnel require for
+// topology / session routing).  keys[i] pairs with str_vals[i] when
+// is_int[i] == 0, else with int_vals[i].  Returns an opaque handle or
+// nullptr (with err_buf filled).
+void* dl4j_pjrt_client_create_opts(const char* plugin_path,
+                                   const char* const* keys,
+                                   const char* const* str_vals,
+                                   const int64_t* int_vals,
+                                   const int* is_int, int n_opts,
+                                   char* err_buf, int err_len) {
+  void* dl = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (dl == nullptr) {
+    set_err(err_buf, err_len, dlerror());
+    return nullptr;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(dl, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    set_err(err_buf, err_len, "plugin has no GetPjrtApi symbol");
+    dlclose(dl);
+    return nullptr;
+  }
+  const PJRT_Api* api = get_api();
+  if (api == nullptr) {
+    set_err(err_buf, err_len, "GetPjrtApi returned null");
+    dlclose(dl);
+    return nullptr;
+  }
+
+  std::vector<PJRT_NamedValue> options((size_t)n_opts);
+  for (int i = 0; i < n_opts; ++i) {
+    PJRT_NamedValue* nv = &options[i];
+    memset(nv, 0, sizeof(*nv));
+    nv->struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv->name = keys[i];
+    nv->name_size = strlen(keys[i]);
+    if (is_int[i]) {
+      nv->type = PJRT_NamedValue_kInt64;
+      nv->int64_value = int_vals[i];
+      nv->value_size = 1;
+    } else {
+      nv->type = PJRT_NamedValue_kString;
+      nv->string_value = str_vals[i];
+      nv->value_size = strlen(str_vals[i]);
+    }
+  }
+
+  PJRT_Client_Create_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  args.create_options = options.data();
+  args.num_options = (size_t)n_opts;
+  PJRT_Error* error = api->PJRT_Client_Create(&args);
+  if (error != nullptr) {
+    consume_error(api, error, err_buf, err_len);
+    dlclose(dl);
+    return nullptr;
+  }
+  ShimClient* shim = new ShimClient();
+  shim->dl_handle = dl;
+  shim->api = api;
+  shim->client = args.client;
+  return shim;
+}
+
+// Optionless create (CPU-style plugins).
+void* dl4j_pjrt_client_create(const char* plugin_path, char* err_buf,
+                              int err_len) {
+  return dl4j_pjrt_client_create_opts(plugin_path, nullptr, nullptr,
+                                      nullptr, nullptr, 0, err_buf,
+                                      err_len);
+}
+
+void dl4j_pjrt_client_destroy(void* handle) {
+  if (handle == nullptr) return;
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  if (shim->client != nullptr) {
+    PJRT_Client_Destroy_Args args;
+    memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    args.client = shim->client;
+    consume_error(shim->api, shim->api->PJRT_Client_Destroy(&args),
+                  nullptr, 0);
+  }
+  // NOTE: the plugin .so stays mapped (plugins generally do not support
+  // re-initialisation after dlclose).
+  delete shim;
+}
+
+int dl4j_pjrt_api_version(void* handle, int* major, int* minor) {
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  if (shim == nullptr || shim->api == nullptr) return -1;
+  *major = shim->api->pjrt_api_version.major_version;
+  *minor = shim->api->pjrt_api_version.minor_version;
+  return 0;
+}
+
+int dl4j_pjrt_platform_name(void* handle, char* out, int out_len) {
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  PJRT_Client_PlatformName_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  args.client = shim->client;
+  PJRT_Error* error = shim->api->PJRT_Client_PlatformName(&args);
+  if (error != nullptr) {
+    consume_error(shim->api, error, out, out_len);
+    return -1;
+  }
+  size_t n = args.platform_name_size < (size_t)(out_len - 1)
+                 ? args.platform_name_size
+                 : (size_t)(out_len - 1);
+  memcpy(out, args.platform_name, n);
+  out[n] = '\0';
+  return (int)n;
+}
+
+int dl4j_pjrt_device_count(void* handle) {
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  return (int)addressable_devices(shim).size();
+}
+
+// Compile a textual StableHLO/MLIR module and run it on the first
+// addressable device with `num_inputs` f32 vector inputs of length n
+// each (flattened), writing the single f32 output (length out_n).
+// Returns 0 on success.
+int dl4j_pjrt_run_mlir(void* handle, const char* mlir_code,
+                       const char* compile_options,
+                       int64_t compile_options_size,
+                       const float* const* inputs, int num_inputs,
+                       int64_t n, float* output, int64_t out_n,
+                       char* err_buf, int err_len) {
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  const PJRT_Api* api = shim->api;
+
+  std::vector<PJRT_Device*> devices = addressable_devices(shim);
+  if (devices.empty()) {
+    set_err(err_buf, err_len, "no addressable devices");
+    return -1;
+  }
+
+  // -- compile ------------------------------------------------------------
+  PJRT_Program program;
+  memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = const_cast<char*>(mlir_code);
+  program.code_size = strlen(mlir_code);
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args compile_args;
+  memset(&compile_args, 0, sizeof(compile_args));
+  compile_args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  compile_args.client = shim->client;
+  compile_args.program = &program;
+  // Serialized CompileOptionsProto from the caller (empty = all proto
+  // defaults; some plugins require explicit build options).
+  compile_args.compile_options =
+      compile_options != nullptr ? compile_options : "";
+  compile_args.compile_options_size = (size_t)compile_options_size;
+  PJRT_Error* error = api->PJRT_Client_Compile(&compile_args);
+  if (error != nullptr) {
+    consume_error(api, error, err_buf, err_len);
+    return -2;
+  }
+  PJRT_LoadedExecutable* executable = compile_args.executable;
+
+  // -- host -> device transfers ------------------------------------------
+  std::vector<PJRT_Buffer*> in_buffers;
+  int rc = 0;
+  for (int i = 0; i < num_inputs && rc == 0; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args h2d;
+    memset(&h2d, 0, sizeof(h2d));
+    h2d.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    h2d.client = shim->client;
+    h2d.data = inputs[i];
+    h2d.type = PJRT_Buffer_Type_F32;
+    h2d.dims = &n;
+    h2d.num_dims = 1;
+    h2d.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    h2d.device = devices[0];
+    error = api->PJRT_Client_BufferFromHostBuffer(&h2d);
+    if (error != nullptr) {
+      consume_error(api, error, err_buf, err_len);
+      rc = -3;
+      break;
+    }
+    in_buffers.push_back(h2d.buffer);
+    if (!await_event(api, h2d.done_with_host_buffer, err_buf, err_len)) {
+      rc = -3;
+    }
+  }
+
+  // -- execute ------------------------------------------------------------
+  PJRT_Buffer* out_buffer = nullptr;
+  if (rc == 0) {
+    PJRT_ExecuteOptions exec_options;
+    memset(&exec_options, 0, sizeof(exec_options));
+    exec_options.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    PJRT_Buffer* const* arg_list = in_buffers.data();
+    PJRT_Buffer** output_list = &out_buffer;
+    PJRT_Buffer** const* output_lists = &output_list;
+    PJRT_Event* device_complete_event = nullptr;
+
+    PJRT_LoadedExecutable_Execute_Args exec_args;
+    memset(&exec_args, 0, sizeof(exec_args));
+    exec_args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    exec_args.executable = executable;
+    exec_args.options = &exec_options;
+    exec_args.argument_lists = &arg_list;
+    exec_args.num_devices = 1;
+    exec_args.num_args = (size_t)num_inputs;
+    exec_args.output_lists = const_cast<PJRT_Buffer***>(output_lists);
+    exec_args.device_complete_events = &device_complete_event;
+    exec_args.execute_device = devices[0];
+    error = api->PJRT_LoadedExecutable_Execute(&exec_args);
+    if (error != nullptr) {
+      consume_error(api, error, err_buf, err_len);
+      rc = -4;
+    } else if (!await_event(api, device_complete_event, err_buf,
+                            err_len)) {
+      rc = -4;
+    }
+  }
+
+  // -- device -> host -----------------------------------------------------
+  if (rc == 0) {
+    PJRT_Buffer_ToHostBuffer_Args d2h;
+    memset(&d2h, 0, sizeof(d2h));
+    d2h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    d2h.src = out_buffer;
+    d2h.dst = output;
+    d2h.dst_size = (size_t)(out_n * (int64_t)sizeof(float));
+    error = api->PJRT_Buffer_ToHostBuffer(&d2h);
+    if (error != nullptr) {
+      consume_error(api, error, err_buf, err_len);
+      rc = -5;
+    } else if (!await_event(api, d2h.event, err_buf, err_len)) {
+      rc = -5;
+    }
+  }
+
+  // -- cleanup ------------------------------------------------------------
+  for (PJRT_Buffer* buf : in_buffers) {
+    PJRT_Buffer_Destroy_Args destroy_buf;
+    memset(&destroy_buf, 0, sizeof(destroy_buf));
+    destroy_buf.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    destroy_buf.buffer = buf;
+    consume_error(api, api->PJRT_Buffer_Destroy(&destroy_buf), nullptr,
+                  0);
+  }
+  if (out_buffer != nullptr) {
+    PJRT_Buffer_Destroy_Args destroy_buf;
+    memset(&destroy_buf, 0, sizeof(destroy_buf));
+    destroy_buf.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    destroy_buf.buffer = out_buffer;
+    consume_error(api, api->PJRT_Buffer_Destroy(&destroy_buf), nullptr,
+                  0);
+  }
+  PJRT_LoadedExecutable_Destroy_Args destroy_exec;
+  memset(&destroy_exec, 0, sizeof(destroy_exec));
+  destroy_exec.struct_size =
+      PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  destroy_exec.executable = executable;
+  consume_error(api, api->PJRT_LoadedExecutable_Destroy(&destroy_exec),
+                nullptr, 0);
+  return rc;
+}
+
+}  // extern "C"

@@ -87,6 +87,15 @@ class TestVPTree:
         idx, dist = tree.knn(np.ones(3), k=1)
         assert idx.tolist() == [0]
 
+    def test_duplicate_heavy_data_builds_and_searches(self):
+        # 5000 identical rows: a recursive build would blow the stack
+        pts = np.zeros((5000, 4), np.float32)
+        pts[0] = [1, 1, 1, 1]
+        tree = VPTree(pts)
+        idx, dist = tree.knn(np.array([1, 1, 1, 1], np.float32), k=1)
+        assert idx.tolist() == [0]
+        assert dist[0] == 0.0
+
 
 class TestTsne:
     def test_embedding_separates_blobs(self):
