@@ -187,6 +187,47 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
             "vs_baseline": None}
 
 
+def bench_vgg16(batch: int = 32, steps: int = 8, trials: int = 3) -> dict:
+    """VGG-16 training step (BASELINE config #5: the Keras-import
+    architecture — built through keras/trained_models.vgg16, the same
+    config the importer targets), single chip; the 16-chip data-parallel
+    variant needs hardware this session doesn't have."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.keras.trained_models import vgg16
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = vgg16(compute_dtype=_bf16_if_tpu())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    f = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    l = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, batch)])
+
+    def one_step():
+        (net.params, net.updater_state, net.net_state, score) = \
+            net._train_step(net.params, net.updater_state, net.net_state,
+                            net.iteration, f, l, None, None, net._rng_key)
+        net.iteration += 1
+        return score
+
+    float(np.asarray(one_step()))   # warmup; fetch = completion barrier
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            score = one_step()
+        float(np.asarray(score))
+        return time.perf_counter() - t0
+
+    elapsed = _best_of(timed, trials)
+    sps = steps * batch / elapsed
+    return {"metric": "vgg16_import_train_samples_per_sec_per_chip",
+            "value": round(sps, 1), "unit": "samples/sec/chip",
+            "vs_baseline": None}
+
+
 def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
                    negative: int = 5, steps: int = 20,
                    trials: int = 3) -> dict:
@@ -272,7 +313,8 @@ def main() -> None:
     print(json.dumps(result), flush=True)
     if not run_all:
         return
-    for fn in (bench_resnet50, bench_lstm, bench_word2vec, bench_scaling):
+    for fn in (bench_resnet50, bench_vgg16, bench_lstm, bench_word2vec,
+               bench_scaling):
         try:
             print(json.dumps(fn()), file=sys.stderr, flush=True)
         except Exception as e:  # keep going: one config failing is data too

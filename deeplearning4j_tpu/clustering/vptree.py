@@ -82,13 +82,17 @@ class VPTree:
             d = self._dist_many(self.items[vp], rest)
             median = float(np.median(d))
             node.threshold = median
-            inside = [i for i, di in zip(rest, d) if di <= median]
+            # Points AT the median satisfy both subtree invariants
+            # (inside: d <= t, outside: d >= t), so distribute them to
+            # keep the tree balanced — duplicate-heavy data would
+            # otherwise degenerate to a list.  The search bounds stay
+            # valid because outside only ever holds d >= threshold.
+            inside = [i for i, di in zip(rest, d) if di < median]
             outside = [i for i, di in zip(rest, d) if di > median]
-            if not outside and len(inside) > 1:
-                # all ties (e.g. identical points): split arbitrarily so
-                # the tree stays balanced instead of degenerating
-                half = len(inside) // 2
-                inside, outside = inside[:half], inside[half:]
+            for i, di in zip(rest, d):
+                if di == median:
+                    (inside if len(inside) <= len(outside)
+                     else outside).append(i)
             if inside:
                 work.append((node, "inside", inside))
             if outside:

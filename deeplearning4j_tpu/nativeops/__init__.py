@@ -49,8 +49,13 @@ _lib: Optional[ctypes.CDLL] = None
 def build_native(force: bool = False) -> str:
     """Compile ``native/`` into the shared library (no-op if current)."""
     if force or not os.path.exists(_LIB_PATH):
-        subprocess.run(["make"] + (["-B"] if force else []),
-                       cwd=_NATIVE_DIR, check=True, capture_output=True)
+        proc = subprocess.run(["make"] + (["-B"] if force else []),
+                              cwd=_NATIVE_DIR, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "native build failed:\n" + (proc.stderr or proc.stdout)
+                [-2000:])
     return _LIB_PATH
 
 

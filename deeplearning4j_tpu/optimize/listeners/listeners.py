@@ -114,3 +114,146 @@ class CollectScoresIterationListener(IterationListener):
     def iteration_done(self, model, iteration: int) -> None:
         if iteration % self.frequency == 0:
             self.scores.append((iteration, model.score()))
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-parameter statistics every N iterations (reference
+    ``ParamAndGradientIterationListener.java``: mean, min/max, mean
+    absolute value, tab-delimited to console and/or file).
+
+    Gradients are fused inside the jitted train step and never
+    materialise host-side, so the reference's gradient columns are
+    reported as *update* statistics — the parameter delta since this
+    listener last ran, which is what the updater applied (the same
+    substitution the stats listener makes; update:param magnitude ratios
+    are the quantity the reference UI derives from these columns anyway).
+    """
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = True,
+                 print_mean_abs_value: bool = True,
+                 output_to_console: bool = True,
+                 file_path: Optional[str] = None, delimiter: str = "\t"):
+        self.iterations = max(1, iterations)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs = print_mean_abs_value
+        self.output_to_console = output_to_console
+        self.file_path = file_path
+        self.delimiter = delimiter
+        self._last_params = None
+        self._header_written = False
+        if file_path:
+            # truncate once; appends follow (reference opens with append
+            # after an initial header write)
+            open(file_path, "w").close()
+
+    @staticmethod
+    def _tables(model):
+        if hasattr(model, "param_table"):
+            return model.param_table()
+        return {}
+
+    def _stats(self, name, arr, prev):
+        cols = [name]
+        if self.print_mean:
+            cols.append(f"{float(np.mean(arr)):.6g}")
+        if self.print_min_max:
+            cols += [f"{float(np.min(arr)):.6g}",
+                     f"{float(np.max(arr)):.6g}"]
+        if self.print_mean_abs:
+            cols.append(f"{float(np.mean(np.abs(arr))):.6g}")
+        upd = arr - prev if prev is not None else np.zeros_like(arr)
+        if self.print_mean:
+            cols.append(f"{float(np.mean(upd)):.6g}")
+        if self.print_min_max:
+            cols += [f"{float(np.min(upd)):.6g}",
+                     f"{float(np.max(upd)):.6g}"]
+        if self.print_mean_abs:
+            cols.append(f"{float(np.mean(np.abs(upd))):.6g}")
+        return cols
+
+    def _header(self):
+        cols = ["param"]
+        for kind in ("param", "update"):
+            if self.print_mean:
+                cols.append(f"{kind}_mean")
+            if self.print_min_max:
+                cols += [f"{kind}_min", f"{kind}_max"]
+            if self.print_mean_abs:
+                cols.append(f"{kind}_mean_abs")
+        return cols
+
+    def _emit(self, line: str) -> None:
+        if self.output_to_console:
+            logger.info(line)
+        if self.file_path:
+            with open(self.file_path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.iterations != 0:
+            return
+        tables = self._tables(model)
+        if self.print_header and not self._header_written:
+            self._emit(self.delimiter.join(
+                ["iteration"] + self._header()))
+            self._header_written = True
+        prev = self._last_params or {}
+        for name, arr in tables.items():
+            cols = self._stats(name, arr, prev.get(name))
+            self._emit(self.delimiter.join([str(iteration)] + cols))
+        self._last_params = tables
+
+
+class ProfilerListener(TrainingListener):
+    """jax.profiler hookup (SURVEY.md §5 tracing/profiling): capture a
+    device trace for iterations ``[start_iteration, end_iteration)`` into
+    ``log_dir`` (viewable in TensorBoard/Perfetto), plus host-side phase
+    timings per iteration.  The reference exposes runtime timing through
+    PerformanceListener; XLA's profiler is the TPU-native deep-dive
+    equivalent."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 2,
+                 end_iteration: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.end_iteration = end_iteration
+        self._tracing = False
+        self._last_t: Optional[float] = None
+        self.iteration_times_ms: List[float] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import jax
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self.iteration_times_ms.append((now - self._last_t) * 1e3)
+        self._last_t = now
+        if not self._tracing and iteration >= self.start_iteration \
+                and iteration < self.end_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+        elif self._tracing and iteration >= self.end_iteration:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def stop(self) -> None:
+        """Close a still-open capture (only needed when training ended
+        before ``end_iteration``).  Deliberately NOT hooked to epoch
+        boundaries — a capture window spanning epochs must stay one
+        contiguous trace."""
+        if self._tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def phase_report(self) -> dict:
+        """Host-side phase timing summary (mean/p50/p95 iteration ms)."""
+        if not self.iteration_times_ms:
+            return {"iterations": 0}
+        arr = np.asarray(self.iteration_times_ms)
+        return {"iterations": int(arr.size),
+                "mean_ms": float(arr.mean()),
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p95_ms": float(np.percentile(arr, 95))}

@@ -1,0 +1,121 @@
+"""Asynchronous parameter-server data parallelism.
+
+Reference: ``deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/
+.../parallelism/ParameterServerParallelWrapper.java`` (workers train
+replicas and exchange parameters through ND4J's Aeron-based parameter
+server — UDP media driver, native C++/Java) and the
+``nd4j-parameter-server`` update/subscribe model.
+
+TPU-native redesign: synchronous data parallelism rides XLA collectives
+(``parallel/parallel_wrapper.py``); the *asynchronous* path — staleness-
+tolerant Hogwild-style updates, the reason the reference runs a parameter
+server at all — is hosted here as an in-process server with the same
+push/pull surface the Aeron transport provides.  Workers run their jitted
+replica steps concurrently (JAX releases the GIL during device compute,
+so worker threads genuinely overlap), push parameter deltas, and pull the
+latest consolidated parameters; the server applies deltas as they arrive.
+Multi-host deployments would swap the thread transport for
+``jax.distributed`` DCN messaging with the same ParameterServer surface
+(the ``scaleout/dcn.py`` wiring).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..datasets.dataset import DataSet
+
+
+class ParameterServer:
+    """Thread-safe parameter store with asynchronous delta application
+    (the in-process stand-in for the reference's Aeron server).
+
+    ``pull()`` returns a snapshot of the current flat parameters;
+    ``push(delta)`` applies a worker's parameter delta scaled by
+    ``update_scale`` (1/num_workers by default — concurrent full deltas
+    would otherwise apply the same learning signal num_workers times)."""
+
+    def __init__(self, initial_params: np.ndarray,
+                 update_scale: float = 1.0):
+        self._params = np.array(initial_params, np.float64)
+        self.update_scale = float(update_scale)
+        self._lock = threading.Lock()
+        self.pushes = 0
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._params.copy()
+
+    def push(self, delta: np.ndarray) -> None:
+        d = np.asarray(delta, np.float64)
+        with self._lock:
+            self._params += self.update_scale * d
+            self.pushes += 1
+
+
+class ParameterServerParallelWrapper:
+    """Asynchronous multi-replica trainer over a :class:`ParameterServer`
+    (reference ``ParameterServerParallelWrapper``).
+
+    Each worker owns a full model replica; per fit round it pulls the
+    server's parameters, trains ``batches_per_push`` minibatches locally
+    (the jitted step), and pushes its parameter delta.  Updates are
+    staleness-tolerant: no barrier between workers.
+    """
+
+    def __init__(self, model, num_workers: int = 2,
+                 batches_per_push: int = 1,
+                 update_scale: Optional[float] = None):
+        self.model = model.init() if hasattr(model, "init") else model
+        self.num_workers = int(num_workers)
+        self.batches_per_push = int(batches_per_push)
+        scale = (1.0 / self.num_workers if update_scale is None
+                 else update_scale)
+        self.server = ParameterServer(self.model.get_flat_params(), scale)
+        self._replicas = [self.model.clone()
+                          for _ in range(self.num_workers)]
+        self._errors: List[BaseException] = []
+
+    def _worker(self, replica, batches: List[DataSet]) -> None:
+        try:
+            i = 0
+            while i < len(batches):
+                start = self.server.pull()
+                replica.set_flat_params(start)
+                for _ in range(self.batches_per_push):
+                    if i >= len(batches):
+                        break
+                    replica._fit_batch(batches[i])
+                    i += 1
+                self.server.push(replica.get_flat_params() - start)
+        except BaseException as e:  # surfaced after join
+            self._errors.append(e)
+
+    def fit(self, iterator, epochs: int = 1):
+        """Split each epoch's batches round-robin across workers and train
+        asynchronously; the consolidated server parameters land back in
+        ``self.model``."""
+        self._errors = []  # a past failed fit must not poison this one
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            batches = list(iterator) if not isinstance(iterator, list) \
+                else iterator
+            shards: List[List[DataSet]] = [[] for _ in
+                                           range(self.num_workers)]
+            for i, b in enumerate(batches):
+                shards[i % self.num_workers].append(b)
+            threads = [threading.Thread(target=self._worker,
+                                        args=(r, s), daemon=True)
+                       for r, s in zip(self._replicas, shards) if s]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if self._errors:
+                raise self._errors[0]
+        self.model.set_flat_params(self.server.pull())
+        return self.model

@@ -1,0 +1,89 @@
+"""ModelGuesser: load a model/config/normalizer from a path without
+knowing its format.
+
+Reference: ``deeplearning4j-core/.../util/ModelGuesser.java`` —
+``loadConfigGuess`` tries MultiLayerConfiguration JSON → Keras import →
+ComputationGraphConfiguration JSON → YAML; ``loadModelGuess`` tries
+ModelSerializer MLN → ComputationGraph → Keras h5; ``loadNormalizer``
+restores a saved normalizer.  Same cascade here over this framework's
+formats: the model-serializer zip (MLN / graph), Keras-1 h5, config
+JSON, and the normalizer ``.npz``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+
+def load_config_guess(path: str):
+    """Guess + load a *configuration* (reference ``loadConfigGuess``)."""
+    from ..nn.conf.neural_net_configuration import MultiLayerConfiguration
+    from ..nn.conf.computation_graph import ComputationGraphConfiguration
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    errors = []
+    for loader in (MultiLayerConfiguration.from_json,
+                   ComputationGraphConfiguration.from_json):
+        try:
+            return loader(text)
+        except Exception as e:
+            errors.append(f"{loader.__qualname__}: {e}")
+    raise ValueError(
+        f"could not interpret {path!r} as any known configuration:\n  "
+        + "\n  ".join(errors))
+
+
+def load_model_guess(path: str):
+    """Guess + load a *model* (reference ``loadModelGuess``): serializer
+    zip (MLN then graph), then Keras-1 h5 import."""
+    from .model_serializer import (restore_computation_graph,
+                                   restore_multi_layer_network)
+    errors = []
+    if zipfile.is_zipfile(path):
+        for loader in (restore_multi_layer_network,
+                       restore_computation_graph):
+            try:
+                return loader(path)
+            except Exception as e:
+                errors.append(f"{loader.__name__}: {e}")
+    if _looks_like_hdf5(path):
+        from ..keras.keras_model_import import (
+            import_keras_model_and_weights,
+            import_keras_sequential_model_and_weights)
+        for loader in (import_keras_sequential_model_and_weights,
+                       import_keras_model_and_weights):
+            try:
+                return loader(path)
+            except Exception as e:
+                errors.append(f"{loader.__name__}: {e}")
+    raise ValueError(
+        f"could not interpret {path!r} as any known model format:\n  "
+        + "\n  ".join(errors) if errors else
+        f"{path!r} is neither a serializer zip nor a Keras h5 file")
+
+
+def load_normalizer_guess(path: str):
+    """Guess + load a saved normalizer (reference ``loadNormalizer``)."""
+    from ..datasets.normalizers import load_normalizer
+    return load_normalizer(path)
+
+
+def load_guess(path: str):
+    """The widest cascade: model → normalizer → configuration."""
+    errors = []
+    for loader in (load_model_guess, load_normalizer_guess,
+                   load_config_guess):
+        try:
+            return loader(path)
+        except Exception as e:
+            errors.append(str(e).splitlines()[0])
+    raise ValueError(f"could not interpret {path!r}: " + "; ".join(errors))
+
+
+def _looks_like_hdf5(path: str) -> bool:
+    if not os.path.isfile(path):
+        return False
+    with open(path, "rb") as f:
+        return f.read(8) == b"\x89HDF\r\n\x1a\n"

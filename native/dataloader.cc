@@ -56,32 +56,42 @@ extern "C" {
 
 // ------------------------------------------------------------------- IDX
 
-// Parse an IDX header: fills dims[0..ndim) and returns ndim (<=4), or -1.
+// Parse an already-read IDX header buffer into dims; returns ndim or -1.
 // Magic: 0x00000801 (labels, u8 rank1) / 0x00000803 (images, u8 rank3).
-int dl4j_idx_info(const char* path, int64_t* dims, int max_dims) {
-  std::vector<unsigned char> buf = read_file(path);
-  if (buf.size() < 4) return -1;
-  uint32_t magic = read_be32(buf.data());
+static int parse_idx_header(const unsigned char* buf, size_t size,
+                            int64_t* dims, int max_dims) {
+  if (size < 4) return -1;
+  uint32_t magic = read_be32(buf);
   if ((magic & 0xFFFFFF00) != 0x00000800) return -1;
   int ndim = (int)(magic & 0xFF);
-  if (ndim > max_dims || buf.size() < 4 + 4 * (size_t)ndim) return -1;
+  if (ndim > max_dims || size < 4 + 4 * (size_t)ndim) return -1;
   for (int i = 0; i < ndim; ++i) {
-    dims[i] = (int64_t)read_be32(buf.data() + 4 + 4 * i);
+    dims[i] = (int64_t)read_be32(buf + 4 + 4 * i);
   }
   return ndim;
 }
 
+// IDX header info: reads only the header bytes, not the payload.
+int dl4j_idx_info(const char* path, int64_t* dims, int max_dims) {
+  unsigned char header[20];
+  FILE* f = fopen(path, "rb");
+  if (f == nullptr) return -1;
+  size_t got = fread(header, 1, sizeof(header), f);
+  fclose(f);
+  return parse_idx_header(header, got, dims, max_dims);
+}
+
 // Decode IDX u8 payload to float32 (optionally /255).  Returns elements
-// written, or -1 on parse failure / short output buffer.
+// written, or -1 on parse failure / short output buffer.  One file read.
 int64_t dl4j_idx_decode(const char* path, float* out, int64_t max_elems,
                         int normalize) {
+  std::vector<unsigned char> buf = read_file(path);
   int64_t dims[4];
-  int ndim = dl4j_idx_info(path, dims, 4);
+  int ndim = parse_idx_header(buf.data(), buf.size(), dims, 4);
   if (ndim < 0) return -1;
   int64_t total = 1;
   for (int i = 0; i < ndim; ++i) total *= dims[i];
   if (total > max_elems) return -1;
-  std::vector<unsigned char> buf = read_file(path);
   size_t offset = 4 + 4 * (size_t)ndim;
   if (buf.size() < offset + (size_t)total) return -1;
   const float scale = normalize ? (1.0f / 255.0f) : 1.0f;
@@ -134,7 +144,7 @@ struct Prefetcher {
   uint64_t seed;
 
   std::vector<float> slots_f, slots_l;  // capacity x batch x dim
-  std::vector<int> ready;               // slot states (0 empty, 1 full)
+  // slot occupancy is fully determined by head/tail/count under mu
   int head = 0, tail = 0, count = 0;
   bool stop = false;
   pthread_mutex_t mu;
@@ -182,7 +192,6 @@ static void* prefetch_worker(void* arg) {
     pos += p->batch;
 
     pthread_mutex_lock(&p->mu);
-    p->ready[slot] = 1;
     p->tail = (p->tail + 1) % p->capacity;
     p->count++;
     pthread_cond_signal(&p->not_empty);
@@ -206,7 +215,6 @@ void* dl4j_prefetcher_create(const float* features, const float* labels,
   p->seed = seed;
   p->slots_f.resize((size_t)capacity * batch * feat_dim);
   p->slots_l.resize((size_t)capacity * batch * label_dim);
-  p->ready.assign(capacity, 0);
   pthread_mutex_init(&p->mu, nullptr);
   pthread_cond_init(&p->not_full, nullptr);
   pthread_cond_init(&p->not_empty, nullptr);
@@ -239,7 +247,6 @@ int dl4j_prefetcher_next(void* handle, float* feat_out, float* label_out) {
          (size_t)p->batch * p->label_dim * sizeof(float));
 
   pthread_mutex_lock(&p->mu);
-  p->ready[slot] = 0;
   p->head = (p->head + 1) % p->capacity;
   p->count--;
   pthread_cond_signal(&p->not_full);

@@ -267,6 +267,46 @@ int dl4j_pjrt_run_mlir(void* handle, const char* mlir_code,
   }
   PJRT_LoadedExecutable* executable = compile_args.executable;
 
+  // The execute ABI needs output_lists[i] sized to the executable's
+  // output count; this shim supports exactly one result — reject other
+  // arities loudly instead of letting PJRT write past the slot.
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args get_args;
+    memset(&get_args, 0, sizeof(get_args));
+    get_args.struct_size =
+        PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    get_args.loaded_executable = executable;
+    PJRT_Error* gerr = api->PJRT_LoadedExecutable_GetExecutable(&get_args);
+    size_t num_outputs = 1;
+    if (gerr == nullptr) {
+      PJRT_Executable_NumOutputs_Args num_args;
+      memset(&num_args, 0, sizeof(num_args));
+      num_args.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+      num_args.executable = get_args.executable;
+      PJRT_Error* nerr = api->PJRT_Executable_NumOutputs(&num_args);
+      if (nerr == nullptr) {
+        num_outputs = num_args.num_outputs;
+      } else {
+        consume_error(api, nerr, nullptr, 0);
+      }
+    } else {
+      consume_error(api, gerr, nullptr, 0);
+    }
+    if (num_outputs != 1) {
+      set_err(err_buf, err_len,
+              "dl4j_pjrt_run_mlir supports single-output programs only");
+      PJRT_LoadedExecutable_Destroy_Args destroy_exec;
+      memset(&destroy_exec, 0, sizeof(destroy_exec));
+      destroy_exec.struct_size =
+          PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      destroy_exec.executable = executable;
+      consume_error(api,
+                    api->PJRT_LoadedExecutable_Destroy(&destroy_exec),
+                    nullptr, 0);
+      return -2;
+    }
+  }
+
   // -- host -> device transfers ------------------------------------------
   std::vector<PJRT_Buffer*> in_buffers;
   int rc = 0;

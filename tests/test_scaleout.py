@@ -163,3 +163,87 @@ def test_host_shard_partitions_paths():
 def test_cross_host_mean_single_process_identity():
     flat = np.arange(5, dtype=np.float32)
     np.testing.assert_array_equal(cross_host_mean(flat, weight=3.0), flat)
+
+
+# ------------------------------------------------- async parameter server
+
+def test_parameter_server_async_convergence():
+    """Async PS training converges comparably to plain fit (reference
+    ParameterServerParallelWrapperTest pattern)."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        ParameterServerParallelWrapper)
+    from deeplearning4j_tpu import (DataSet, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iris import iris_dataset
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+    def build():
+        lb = (NeuralNetConfiguration.builder().seed(7).updater("sgd")
+              .learning_rate(0.1).weight_init("xavier")
+              .activation("tanh").list()
+              .layer(DenseLayer(n_in=4, n_out=8))
+              .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                 loss="mcxent")))
+        return MultiLayerNetwork(lb.build()).init()
+
+    ds = iris_dataset()
+    it = ListDataSetIterator(ds, batch_size=30, shuffle=True, seed=0)
+    psw = ParameterServerParallelWrapper(build(), num_workers=3,
+                                         batches_per_push=1)
+    s0 = psw.model.score(ds)
+    # Async convergence depends on thread-scheduling staleness, so train
+    # until the target is met within a generous epoch budget instead of
+    # asserting a fixed-epoch outcome (the constant-lr PS path plateaus —
+    # reference behavior — but where it lands each run is stochastic).
+    for _ in range(6):
+        psw.fit(it, epochs=20)
+        s1 = psw.model.score(ds)
+        acc = float(np.mean(psw.model.predict(ds.features)
+                            == np.argmax(np.asarray(ds.labels), 1)))
+        if s1 < s0 * 0.6 and acc > 0.8:
+            break
+    else:
+        raise AssertionError(
+            f"async PS failed to converge: {s0} -> {s1}, acc {acc}")
+    assert psw.server.pushes >= 40  # asynchronous pushes actually flowed
+
+
+def test_parameter_server_single_worker_equals_sequential():
+    """With one worker and scale 1.0 the pull/train/push round-trip must
+    reproduce plain sequential fit exactly."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        ParameterServerParallelWrapper)
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iris import iris_dataset
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+    def build():
+        lb = (NeuralNetConfiguration.builder().seed(3).updater("sgd")
+              .learning_rate(0.1).weight_init("xavier").dtype("float64")
+              .activation("tanh").list()
+              .layer(DenseLayer(n_in=4, n_out=6))
+              .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                                 loss="mcxent")))
+        return MultiLayerNetwork(lb.build()).init()
+
+    ds = iris_dataset()
+    it = ListDataSetIterator(ds, batch_size=50, shuffle=False)
+    psw = ParameterServerParallelWrapper(build(), num_workers=1)
+    psw.fit(it, epochs=3)
+    ref = build()
+    ref.fit(ListDataSetIterator(ds, batch_size=50, shuffle=False),
+            epochs=3)
+    np.testing.assert_allclose(psw.model.get_flat_params(),
+                               ref.get_flat_params(), rtol=1e-10)
+
+
+def test_parameter_server_push_pull_semantics():
+    from deeplearning4j_tpu.scaleout.param_server import ParameterServer
+    ps = ParameterServer(np.zeros(4), update_scale=0.5)
+    ps.push(np.ones(4))
+    ps.push(np.ones(4) * 2.0)
+    np.testing.assert_allclose(ps.pull(), np.full(4, 1.5))
+    assert ps.pushes == 2

@@ -1,0 +1,137 @@
+"""ModelGuesser + ParamAndGradient/Profiler listener tests (reference
+``ModelGuesserTest`` and the listener tests under
+``deeplearning4j-core/src/test/.../optimize/listener/``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (DataSet, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.iris import iris_dataset
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners.listeners import (
+    ParamAndGradientIterationListener, ProfilerListener)
+from deeplearning4j_tpu.utils.model_guesser import (load_config_guess,
+                                                    load_guess,
+                                                    load_model_guess,
+                                                    load_normalizer_guess)
+from deeplearning4j_tpu.utils.model_serializer import write_model
+
+
+def _mln():
+    lb = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+          .learning_rate(0.1).weight_init("xavier").activation("tanh")
+          .list()
+          .layer(DenseLayer(n_in=4, n_out=6))
+          .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                             loss="mcxent")))
+    return MultiLayerNetwork(lb.build()).init()
+
+
+def _graph():
+    g = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+         .learning_rate(0.1).weight_init("xavier").activation("tanh")
+         .graph_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=6), "in")
+         .add_layer("o", OutputLayer(n_in=6, n_out=3,
+                                     activation="softmax",
+                                     loss="mcxent"), "d")
+         .set_outputs("o").build())
+    return ComputationGraph(g).init()
+
+
+class TestModelGuesser:
+    def test_guesses_mln_zip(self, tmp_path):
+        net = _mln()
+        p = str(tmp_path / "model.zip")
+        write_model(net, p)
+        loaded = load_model_guess(p)
+        assert isinstance(loaded, MultiLayerNetwork)
+        ds = iris_dataset()
+        np.testing.assert_allclose(loaded.output(ds.features),
+                                   net.output(ds.features), rtol=1e-6)
+
+    def test_guesses_graph_zip(self, tmp_path):
+        cg = _graph()
+        p = str(tmp_path / "graph.zip")
+        write_model(cg, p)
+        loaded = load_model_guess(p)
+        assert isinstance(loaded, ComputationGraph)
+
+    def test_guesses_configs(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import \
+            MultiLayerConfiguration
+        p = str(tmp_path / "conf.json")
+        with open(p, "w") as f:
+            f.write(_mln().conf.to_json())
+        conf = load_config_guess(p)
+        assert isinstance(conf, MultiLayerConfiguration)
+
+    def test_guesses_normalizer(self, tmp_path):
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32)
+        norm = NormalizerStandardize().fit(DataSet(x, x))
+        p = str(tmp_path / "norm.npz")
+        norm.save(p)
+        loaded = load_normalizer_guess(p)
+        np.testing.assert_allclose(loaded.transform(x), norm.transform(x),
+                                   atol=1e-6)
+
+    def test_load_guess_cascade(self, tmp_path):
+        net = _mln()
+        pz = str(tmp_path / "m.zip")
+        write_model(net, pz)
+        assert isinstance(load_guess(pz), MultiLayerNetwork)
+        with pytest.raises(ValueError):
+            junk = str(tmp_path / "junk.bin")
+            with open(junk, "wb") as f:
+                f.write(b"\x00" * 64)
+            load_guess(junk)
+
+
+class TestParamAndGradientListener:
+    def test_writes_stats_file(self, tmp_path):
+        p = str(tmp_path / "stats.tsv")
+        net = _mln()
+        net.set_listeners(ParamAndGradientIterationListener(
+            iterations=1, file_path=p, output_to_console=False))
+        net.fit(iris_dataset(), epochs=3)
+        lines = open(p).read().strip().split("\n")
+        header = lines[0].split("\t")
+        assert header[0] == "iteration"
+        assert "param_mean" in header and "update_mean_abs" in header
+        # 4 param tensors (2 layers x W,b) x 3 iterations + header
+        assert len(lines) == 1 + 4 * 3
+        # update columns become non-zero once a previous snapshot exists
+        last = lines[-1].split("\t")
+        upd_mean_abs = float(last[-1])
+        assert upd_mean_abs > 0
+
+    def test_iteration_stride(self, tmp_path):
+        p = str(tmp_path / "stats.tsv")
+        net = _mln()
+        net.set_listeners(ParamAndGradientIterationListener(
+            iterations=2, file_path=p, output_to_console=False))
+        net.fit(iris_dataset(), epochs=4)
+        rows = [l for l in open(p).read().strip().split("\n")[1:]]
+        iters = sorted({int(r.split("\t")[0]) for r in rows})
+        assert iters == [2, 4]
+
+
+class TestProfilerListener:
+    def test_phase_report_and_trace(self, tmp_path):
+        prof = ProfilerListener(str(tmp_path / "trace"),
+                                start_iteration=2, end_iteration=4)
+        net = _mln()
+        net.set_listeners(prof)
+        net.fit(iris_dataset(), epochs=6)
+        rep = prof.phase_report()
+        assert rep["iterations"] == 5  # deltas between 6 iterations
+        assert rep["mean_ms"] > 0 and rep["p95_ms"] >= rep["p50_ms"]
+        # a trace directory was produced for the captured window
+        assert os.path.isdir(str(tmp_path / "trace"))
