@@ -78,17 +78,33 @@ def lstm_scan(W: Array, RW: Array, b: Array, x: Array, carry: Carry, *,
     zeros (the reference zeroes masked epsilons/activations via
     ``MaskedReductionUtil``).
     """
+    # One big MXU matmul for every timestep's input projection.
+    xw = jnp.einsum("bti,ij->btj", x, W) + b
+    return lstm_scan_preact(RW, xw, carry, afn=afn, gate_fn=gate_fn,
+                            mask=mask, reverse=reverse)
+
+
+def lstm_scan_preact(RW: Array, xw: Array, carry: Carry, *,
+                     afn, gate_fn, mask: Optional[Array] = None,
+                     reverse: bool = False) -> Tuple[Array, Carry]:
+    """The recurrent chain of :func:`lstm_scan`, taking the already-
+    projected (batch, time, 4H) preactivations.  Split out so callers that
+    reuse the projection across invocations (the sequence-parallel ring
+    scan in ``parallel/sequence.py``) don't recompute it per round."""
     H = RW.shape[0]
     RWg = RW[:, :4 * H]
     w_ff = RW[:, 4 * H]       # forget-gate peephole (reads c_prev)
     w_oo = RW[:, 4 * H + 1]   # output-gate peephole (reads c_current)
     w_gg = RW[:, 4 * H + 2]   # input-mod-gate peephole (reads c_prev)
 
-    # One big MXU matmul for every timestep's input projection.
-    xw = jnp.einsum("bti,ij->btj", x, W) + b
     xw_t = jnp.swapaxes(xw, 0, 1)                       # (time, batch, 4H)
     mask_t = (None if mask is None
               else jnp.swapaxes(mask, 0, 1))            # (time, batch)
+    # Scan carries must be dtype-stable; under mixed precision (bf16
+    # activations, f32 weights) the step body promotes, so promote the
+    # incoming carry once up front.
+    res_dtype = jnp.result_type(xw.dtype, RW.dtype)
+    carry = jax.tree.map(lambda a: a.astype(res_dtype), carry)
 
     def step(c_prev_pair: Carry, inputs):
         h_prev, c_prev = c_prev_pair
