@@ -69,6 +69,21 @@ class Evaluation:
     def eval_time_series(self, labels, predictions, mask=None) -> None:
         self.eval(labels, predictions, mask)
 
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Fold another evaluation's counts into this one (reference
+        ``IEvaluation.merge`` — the Spark distributed-eval aggregation)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        elif self.num_classes != other.num_classes:
+            raise ValueError(
+                f"Cannot merge evaluations with {self.num_classes} vs "
+                f"{other.num_classes} classes")
+        self.confusion.matrix += other.confusion.matrix
+        return self
+
     # ---- metrics (reference accuracy()/precision()/recall()/f1()) --------
     def accuracy(self) -> float:
         m = self.confusion.matrix

@@ -55,6 +55,28 @@ class RegressionEvaluation:
         self._sum_p2 += np.sum(p * p, axis=0)
         self._sum_yp += np.sum(y * p, axis=0)
 
+    def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
+        """Fold another evaluation's sums into this one (reference
+        ``IEvaluation.merge``)."""
+        if other._sum_err2 is None:
+            return self
+        if self._sum_err2 is None:
+            for name in ("_sum_err2", "_sum_abs", "_sum_y", "_sum_y2",
+                         "_sum_p", "_sum_p2", "_sum_yp"):
+                setattr(self, name, getattr(other, name).copy())
+            self._n = other._n
+            self.column_names = self.column_names or other.column_names
+            return self
+        if self.num_columns() != other.num_columns():
+            raise ValueError(
+                f"Cannot merge {self.num_columns()}-col with "
+                f"{other.num_columns()}-col regression evaluations")
+        for name in ("_sum_err2", "_sum_abs", "_sum_y", "_sum_y2",
+                     "_sum_p", "_sum_p2", "_sum_yp"):
+            setattr(self, name, getattr(self, name) + getattr(other, name))
+        self._n += other._n
+        return self
+
     def num_columns(self) -> int:
         return 0 if self._sum_err2 is None else self._sum_err2.size
 

@@ -52,6 +52,18 @@ class ROC:
             self.fn[i] += int(np.sum(~pred_pos & pos))
             self.tn[i] += int(np.sum(~pred_pos & ~pos))
 
+    def merge(self, other: "ROC") -> "ROC":
+        """Fold another ROC's threshold counts into this one (reference
+        ``IEvaluation.merge``)."""
+        if self.threshold_steps != other.threshold_steps:
+            raise ValueError("Cannot merge ROCs with different "
+                             "threshold_steps")
+        self.tp += other.tp
+        self.fp += other.fp
+        self.fn += other.fn
+        self.tn += other.tn
+        return self
+
     def get_roc_curve(self) -> List[Tuple[float, float, float]]:
         """[(threshold, fpr, tpr)] (reference ``getResults``)."""
         out = []
@@ -99,6 +111,13 @@ class ROCMultiClass:
         for c in range(n_classes):
             roc = self.per_class.setdefault(c, ROC(self.threshold_steps))
             roc.eval(labels[:, c], predictions[:, c])
+
+    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
+        """Fold per-class counts (reference ``IEvaluation.merge``)."""
+        for c, roc in other.per_class.items():
+            mine = self.per_class.setdefault(c, ROC(self.threshold_steps))
+            mine.merge(roc)
+        return self
 
     def get_roc_curve(self, cls: int):
         return self.per_class[cls].get_roc_curve()

@@ -47,6 +47,15 @@ class DataSetExportFunction:
         return path
 
 
+def partition_evenly(items: List, n: int) -> List[List]:
+    """Contiguous near-even partitions (the repartition analogue); never
+    returns empty partitions."""
+    n = max(1, min(n, len(items)))
+    bounds = np.linspace(0, len(items), n + 1).astype(int)
+    return [items[bounds[i]:bounds[i + 1]] for i in range(n)
+            if bounds[i] < bounds[i + 1]]
+
+
 def load_dataset(path: str) -> DataSet:
     """Read one exported minibatch."""
     with np.load(path) as z:

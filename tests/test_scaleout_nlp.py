@@ -1,0 +1,281 @@
+"""Distributed-NLP tier tests (reference dl4j-spark-nlp test patterns:
+``TextPipelineTest``, ``CountCumSumTest``, ``Word2VecTest`` on a local[N]
+context) plus distributed evaluation/scoring on the cluster frontends
+(reference ``TestSparkMultiLayerParameterAveraging.testEvaluation``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout import (ClusterMultiLayer,
+                                         ParameterAveragingTrainingMaster)
+from deeplearning4j_tpu.scaleout.nlp import (ClusterTfidfVectorizer,
+                                             ClusterWord2Vec, CountCumSum,
+                                             TextPipeline)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the dog barks at the quick fox",
+    "a lazy dog sleeps all day",
+    "the fox and the dog are not friends",
+    "quick brown foxes leap over lazy dogs in summer",
+    "day after day the dog sleeps",
+] * 4
+
+
+# ------------------------------------------------------------ TextPipeline
+
+def test_text_pipeline_counts_match_serial():
+    pipe = TextPipeline(min_word_frequency=1, num_workers=4)
+    cache = pipe.build_vocab_cache(CORPUS)
+    # accumulator counts equal a serial count
+    from collections import Counter
+    serial = Counter(tok for s in CORPUS for tok in s.split())
+    assert pipe.word_freq == serial
+    assert cache.word_frequency("the") == serial["the"]
+    assert cache.index_of("the") == 0          # most frequent word first
+
+
+def test_text_pipeline_min_frequency_prunes():
+    pipe = TextPipeline(min_word_frequency=8, num_workers=3)
+    cache = pipe.build_vocab_cache(CORPUS)
+    assert cache.contains_word("the")
+    assert not cache.contains_word("summer")   # appears 4 times < 8
+
+
+def test_text_pipeline_stop_words():
+    pipe = TextPipeline(num_workers=2, stop_words=("the", "a"))
+    seqs = pipe.tokenize(CORPUS[:2])
+    assert all("the" not in s for s in seqs)
+
+
+# ------------------------------------------------------------- CountCumSum
+
+def test_count_cum_sum_matches_serial():
+    counts = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    for parts in (1, 2, 3, 4, 7):
+        out = CountCumSum(counts, num_partitions=parts).cum_sum()
+        expected = np.cumsum([0] + counts[:-1])
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_count_cum_sum_empty():
+    assert CountCumSum([], num_partitions=4).cum_sum().size == 0
+
+
+# --------------------------------------------------------- ClusterWord2Vec
+
+def test_cluster_word2vec_trains_and_embeds():
+    """Distributed word2vec on 4 thread workers learns sane neighborhoods
+    on a synthetic two-topic corpus (the reference Spark Word2Vec test
+    checks vocab + similarity sanity)."""
+    rng = np.random.RandomState(0)
+    animals = ["cat", "dog", "horse", "cow"]
+    tools = ["hammer", "wrench", "drill", "saw"]
+    sentences = []
+    for _ in range(300):
+        group = animals if rng.rand() < 0.5 else tools
+        sentences.append(" ".join(rng.choice(group, 6)))
+    w2v = ClusterWord2Vec(num_workers=4, layer_size=16, window_size=3,
+                          min_word_frequency=1, negative=5.0,
+                          use_hierarchic_softmax=False, batch_size=256,
+                          epochs=3, seed=7, learning_rate=0.05)
+    w2v.fit(sentences)
+    assert w2v.has_word("cat") and w2v.has_word("hammer")
+    assert w2v.word_vector("cat").shape == (16,)
+    # same-topic similarity should exceed cross-topic similarity
+    same = w2v.similarity("cat", "dog")
+    cross = w2v.similarity("cat", "hammer")
+    assert same > cross, (same, cross)
+
+
+def test_cluster_word2vec_single_worker_matches_shape():
+    w2v = ClusterWord2Vec(num_workers=1, layer_size=8, window_size=2,
+                          min_word_frequency=1, use_hierarchic_softmax=True,
+                          batch_size=64, epochs=1)
+    w2v.fit(CORPUS)
+    assert np.asarray(w2v.model.lookup_table.syn0).shape[1] == 8
+    assert w2v.words_nearest("dog", top_n=3)
+
+
+# ------------------------------------------------------------ ClusterTfidf
+
+def test_cluster_tfidf_matches_single_process():
+    from deeplearning4j_tpu.nlp.vectorizer import TfidfVectorizer
+    dist = ClusterTfidfVectorizer(min_word_frequency=1, num_workers=4)
+    dist.fit(CORPUS)
+    serial = TfidfVectorizer(min_word_frequency=1)
+    serial.fit(CORPUS)
+    for text in CORPUS[:3]:
+        d = dist.transform(text)
+        s = serial.transform(text)
+        # same vocab ordering (freq-sorted) -> identical vectors
+        np.testing.assert_allclose(d, s, rtol=1e-6)
+
+
+# ------------------------------------------------- eval merge + distributed
+
+def test_evaluation_merge_equals_joint():
+    rng = np.random.RandomState(1)
+    labels = np.eye(3)[rng.randint(0, 3, 60)]
+    preds = rng.rand(60, 3)
+    joint = Evaluation()
+    joint.eval(labels, preds)
+    a, b = Evaluation(), Evaluation()
+    a.eval(labels[:25], preds[:25])
+    b.eval(labels[25:], preds[25:])
+    a.merge(b)
+    np.testing.assert_array_equal(a.confusion.matrix,
+                                  joint.confusion.matrix)
+    assert a.accuracy() == joint.accuracy()
+
+
+def test_regression_merge_equals_joint():
+    rng = np.random.RandomState(2)
+    y, p = rng.randn(50, 2), rng.randn(50, 2)
+    joint = RegressionEvaluation()
+    joint.eval(y, p)
+    a, b = RegressionEvaluation(), RegressionEvaluation()
+    a.eval(y[:20], p[:20])
+    b.eval(y[20:], p[20:])
+    a.merge(b)
+    for c in range(2):
+        assert a.mean_squared_error(c) == pytest.approx(
+            joint.mean_squared_error(c))
+        assert a.correlation_r2(c) == pytest.approx(joint.correlation_r2(c))
+
+
+def test_roc_merge_equals_joint():
+    rng = np.random.RandomState(3)
+    y = (rng.rand(80) > 0.5).astype(float)
+    p = np.clip(y * 0.6 + rng.rand(80) * 0.4, 0, 1)
+    joint = ROC()
+    joint.eval(y, p)
+    a, b = ROC(), ROC()
+    a.eval(y[:40], p[:40])
+    b.eval(y[40:], p[40:])
+    a.merge(b)
+    assert a.calculate_auc() == pytest.approx(joint.calculate_auc())
+
+    mc_joint = ROCMultiClass()
+    labels2 = np.eye(2)[(y > 0.5).astype(int)]
+    preds2 = np.stack([1 - p, p], axis=1)
+    mc_joint.eval(labels2, preds2)
+    ma, mb = ROCMultiClass(), ROCMultiClass()
+    ma.eval(labels2[:40], preds2[:40])
+    mb.eval(labels2[40:], preds2[40:])
+    ma.merge(mb)
+    assert ma.calculate_average_auc() == pytest.approx(
+        mc_joint.calculate_average_auc())
+
+
+def _conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(42).updater("sgd").learning_rate(0.3)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+
+
+def _batches(n_batches=8, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        X = rng.randn(batch, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+        out.append(DataSet(X, np.eye(3, dtype=np.float32)[y]))
+    return out
+
+
+def test_cluster_word2vec_respects_stop_words_and_iterations():
+    w2v = ClusterWord2Vec(num_workers=2, layer_size=8, window_size=2,
+                          min_word_frequency=1, batch_size=64, epochs=1,
+                          iterations=2, stop_words=("the",))
+    w2v.fit(CORPUS)
+    assert not w2v.has_word("the")
+    assert w2v.has_word("dog")
+
+
+def test_distributed_evaluate_masked_time_series_matches_local():
+    """Padded RNN eval through the distributed path must equal the
+    container's own masked evaluate."""
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(GravesLSTM(n_in=3, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(4):
+        f = rng.randn(6, 7, 3).astype(np.float32)
+        l = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (6, 7))]
+        mask = (rng.rand(6, 7) > 0.3).astype(np.float32)
+        mask[:, 0] = 1.0
+        batches.append(DataSet(f, l, features_mask=mask, labels_mask=mask))
+    master = ParameterAveragingTrainingMaster(num_workers=2)
+    front = ClusterMultiLayer(net, master)
+    dist = front.evaluate(batches)
+    local = net.evaluate(batches)
+    np.testing.assert_array_equal(dist.confusion.matrix,
+                                  local.confusion.matrix)
+
+
+def test_distributed_evaluate_matches_local():
+    net = MultiLayerNetwork(_conf()).init()
+    batches = _batches()
+    for ds in batches[:4]:
+        net.fit(ds)
+    master = ParameterAveragingTrainingMaster(num_workers=4,
+                                              averaging_frequency=1)
+    front = ClusterMultiLayer(net, master)
+    dist_eval = front.evaluate(batches)
+    local = Evaluation()
+    for ds in batches:
+        local.eval(ds.labels, net.output(ds.features))
+    np.testing.assert_array_equal(dist_eval.confusion.matrix,
+                                  local.confusion.matrix)
+    assert dist_eval.accuracy() == local.accuracy()
+
+
+def test_distributed_regression_and_score():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd").learning_rate(0.1)
+            .activation("identity").weight_init("xavier")
+            .list()
+            .layer(OutputLayer(n_out=2, activation="identity", loss="mse"))
+            .set_input_type(inputs.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(5)
+    batches = [DataSet(rng.randn(16, 3).astype(np.float32),
+                       rng.randn(16, 2).astype(np.float32))
+               for _ in range(6)]
+    master = ParameterAveragingTrainingMaster(num_workers=3)
+    front = ClusterMultiLayer(net, master)
+
+    reg = front.evaluate_regression(batches)
+    local = RegressionEvaluation()
+    for ds in batches:
+        local.eval(ds.labels, net.output(ds.features))
+    for c in range(2):
+        assert reg.mean_squared_error(c) == pytest.approx(
+            local.mean_squared_error(c))
+
+    dist_score = front.calculate_score(batches)
+    local_scores = [float(net.score(ds)) for ds in batches]
+    assert dist_score == pytest.approx(np.mean(local_scores), rel=1e-6)
