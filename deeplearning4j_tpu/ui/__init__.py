@@ -9,18 +9,34 @@ TPU-native equivalent of the reference's ``deeplearning4j-ui-parent`` +
 - :mod:`stats_listener` — ``StatsListener`` training hook sampling score,
   learning rates, throughput, per-param histograms/magnitudes and process
   memory (reference ``ui/stats/BaseStatsListener.java``).
-- :mod:`server` — ``UIServer`` HTTP dashboard + remote stats receiver
-  (reference ``ui/play/PlayUIServer.java`` + ``module/train/TrainModule``,
+- :mod:`server` — ``UIServer`` HTTP dashboard + remote stats receiver +
+  t-SNE viz module (reference ``ui/play/PlayUIServer.java`` +
+  ``module/train/TrainModule``, ``module/tsne``,
   ``RemoteUIStatsStorageRouter``).
+- :mod:`components` — JSON-serializable chart/table/text components with
+  server-side SVG rendering (reference ``deeplearning4j-ui-components``).
+- :mod:`legacy` — ``HistogramIterationListener`` and
+  ``ConvolutionalIterationListener`` (reference ``deeplearning4j-ui``
+  Dropwizard-era listeners).
 """
 
 from .storage import (FileStatsStorage, InMemoryStatsStorage, Persistable,
                       StatsStorage, StatsStorageRouter)
 from .stats_listener import StatsListener
 from .server import RemoteStatsStorageRouter, UIServer
+from .components import (ChartHistogram, ChartLine, ChartScatter, Component,
+                         ComponentDiv, ComponentTable, ComponentText,
+                         StyleChart, StyleTable, StyleText, render_page,
+                         render_to_file)
+from .legacy import (ConvolutionalIterationListener,
+                     HistogramIterationListener)
 
 __all__ = [
     "FileStatsStorage", "InMemoryStatsStorage", "Persistable",
     "StatsStorage", "StatsStorageRouter", "StatsListener",
     "RemoteStatsStorageRouter", "UIServer",
+    "ChartHistogram", "ChartLine", "ChartScatter", "Component",
+    "ComponentDiv", "ComponentTable", "ComponentText", "StyleChart",
+    "StyleTable", "StyleText", "render_page", "render_to_file",
+    "ConvolutionalIterationListener", "HistogramIterationListener",
 ]

@@ -113,6 +113,55 @@ refresh(); setInterval(refresh, 2000);
 </script></body></html>
 """
 
+_TSNE_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU t-SNE</title>
+<style>
+body { font-family: sans-serif; margin: 1.5em; background: #fafafa; }
+h1 { font-size: 1.3em; }
+.chart { background: #fff; border: 1px solid #ddd; }
+</style></head>
+<body>
+<h1>t-SNE embedding</h1>
+<svg id="tsne" class="chart" width="720" height="560"></svg>
+<script>
+async function refresh() {
+  const d = await (await fetch('tsne/data')).json();
+  const svg = document.getElementById('tsne');
+  svg.innerHTML = '';
+  const pts = d.coords || [];
+  if (!pts.length) return;
+  const W = svg.width.baseVal.value, H = svg.height.baseVal.value;
+  // reduce, not Math.min(...xs): spread throws past ~65k args
+  const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
+  const x0 = xs.reduce((a, b) => Math.min(a, b), Infinity);
+  const x1 = Math.max(xs.reduce((a, b) => Math.max(a, b), -Infinity),
+                      x0 + 1e-9);
+  const y0 = ys.reduce((a, b) => Math.min(a, b), Infinity);
+  const y1 = Math.max(ys.reduce((a, b) => Math.max(a, b), -Infinity),
+                      y0 + 1e-9);
+  const X = v => 15 + (W - 30) * (v - x0) / (x1 - x0);
+  const Y = v => H - 15 - (H - 30) * (v - y0) / (y1 - y0);
+  pts.forEach((p, i) => {
+    const c = document.createElementNS('http://www.w3.org/2000/svg',
+                                       'circle');
+    c.setAttribute('cx', X(p[0])); c.setAttribute('cy', Y(p[1]));
+    c.setAttribute('r', 2.5); c.setAttribute('fill', '#1976d2');
+    svg.appendChild(c);
+    const label = (d.labels || [])[i];
+    if (label !== undefined && label !== null) {
+      const t = document.createElementNS('http://www.w3.org/2000/svg',
+                                         'text');
+      t.setAttribute('x', X(p[0]) + 4); t.setAttribute('y', Y(p[1]) - 3);
+      t.setAttribute('font-size', '9');
+      t.textContent = label;
+      svg.appendChild(t);
+    }
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPUUI/1.0"
@@ -146,22 +195,37 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.overview_data(sid))
         elif path == "/train/model/data":
             self._json(ui.model_data(sid))
+        elif path == "/tsne":
+            self._send(200, _TSNE_PAGE.encode(), "text/html")
+        elif path == "/tsne/data":
+            self._json(ui.tsne_data())
         else:
             self._send(404, b'{"error": "not found"}')
 
-    # ---- POST /remote (RemoteUIStatsStorageRouter receiver) --------------
+    # ---- POST /remote (RemoteUIStatsStorageRouter receiver) + /tsne ------
     def do_POST(self):
         ui: "UIServer" = self.server.ui            # type: ignore
-        if urlparse(self.path).path.rstrip("/") != "/remote":
+        path = urlparse(self.path).path.rstrip("/")
+        if path not in ("/remote", "/tsne/upload"):
+            # Route before touching the body: unknown paths must 404 even
+            # with an empty/non-JSON body.
             self._send(404, b'{"error": "not found"}')
             return
         length = int(self.headers.get("Content-Length", "0"))
-        payload = json.loads(self.rfile.read(length).decode())
-        record = Persistable(**payload["record"])
-        if payload.get("kind") == "static":
-            ui.storage.put_static_info(record)
-        else:
-            ui.storage.put_update(record)
+        try:
+            payload = json.loads(self.rfile.read(length).decode())
+            if path == "/remote":
+                record = Persistable(**payload["record"])
+                if payload.get("kind") == "static":
+                    ui.storage.put_static_info(record)
+                else:
+                    ui.storage.put_update(record)
+            else:
+                ui.set_tsne_data(payload.get("coords", []),
+                                 payload.get("labels"))
+        except Exception as e:
+            self._send(400, json.dumps({"error": repr(e)}).encode())
+            return
         self._json({"status": "ok"})
 
 
@@ -178,6 +242,7 @@ class UIServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._tsne: dict = {"coords": [], "labels": None}
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
@@ -202,6 +267,26 @@ class UIServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}/train/overview"
+
+    # ---- t-SNE module (reference ui-parent tsne module) ------------------
+    def set_tsne_data(self, coords, labels=None) -> "UIServer":
+        """Publish 2-D embedding coordinates (e.g. from
+        :class:`deeplearning4j_tpu.plot.tsne.BarnesHutTsne`) for the
+        ``/tsne`` page."""
+        import numpy as _np
+        coords = _np.asarray(coords, float)
+        if coords.size == 0:
+            coords = coords.reshape(0, 2)       # [] clears the plot
+        if coords.ndim != 2 or coords.shape[1] < 2:
+            raise ValueError(f"coords must be (n, 2+), got {coords.shape}")
+        self._tsne = {
+            "coords": coords[:, :2].tolist(),
+            "labels": None if labels is None else [str(l) for l in labels],
+        }
+        return self
+
+    def tsne_data(self) -> dict:
+        return self._tsne
 
     # ---- data assembly (TrainModule.java role) ---------------------------
     def list_sessions(self) -> List[str]:

@@ -1,0 +1,235 @@
+"""UI components / legacy listeners / t-SNE module tests (reference
+``ui-components/.../TestRendering.java``, legacy listener behavior, and
+the play-server tsne module)."""
+
+import json
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (ChartHistogram, ChartLine, ChartScatter,
+                                   Component, ComponentDiv, ComponentTable,
+                                   ComponentText,
+                                   ConvolutionalIterationListener,
+                                   HistogramIterationListener, StyleChart,
+                                   StyleText, UIServer, render_page,
+                                   render_to_file)
+from deeplearning4j_tpu.ui.legacy import activation_grid, write_png_gray
+
+
+# ---------------------------------------------------------------- components
+
+def _sample_components():
+    line = ChartLine("score", StyleChart(width=400, height=200))
+    line.add_series("train", [0, 1, 2, 3], [1.0, 0.7, 0.5, 0.4])
+    line.add_series("test", [0, 1, 2, 3], [1.1, 0.8, 0.6, 0.55])
+    scatter = ChartScatter("embedding")
+    scatter.add_series("pts", [0.1, 0.5, 0.9], [0.2, 0.8, 0.3])
+    hist = ChartHistogram("weights")
+    hist.add_bin(-1, 0, 12).add_bin(0, 1, 30)
+    table = ComponentTable(["param", "mean"], [["0_W", 0.02], ["0_b", 0.0]])
+    text = ComponentText("hello", StyleText(bold=True))
+    div = ComponentDiv([line, table])
+    return [line, scatter, hist, table, text, div]
+
+
+def test_components_json_round_trip():
+    for c in _sample_components():
+        restored = Component.from_json(c.to_json())
+        assert type(restored) is type(c)
+        assert restored.to_dict() == c.to_dict()
+
+
+def test_components_render_html():
+    for c in _sample_components():
+        html = c.render_html()
+        assert html.startswith("<")
+        if isinstance(c, (ChartLine, ChartScatter, ChartHistogram)):
+            assert "<svg" in html
+
+
+def test_render_page_and_file(tmp_path):
+    page = render_page(_sample_components(), title="test page")
+    assert page.startswith("<!DOCTYPE html>")
+    assert "test page" in page
+    path = render_to_file(_sample_components(), str(tmp_path / "out.html"))
+    assert (tmp_path / "out.html").read_text().startswith("<!DOCTYPE")
+
+
+def test_empty_chart_renders():
+    assert "<svg" in ChartLine("empty").render_html()
+
+
+def test_chart_series_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        ChartLine("x").add_series("s", [1, 2], [1])
+
+
+# ----------------------------------------------------------------- PNG util
+
+def _decode_png_gray(path):
+    raw = open(path, "rb").read()
+    assert raw[:8] == b"\x89PNG\r\n\x1a\n"
+    pos, idat, w, h = 8, b"", None, None
+    while pos < len(raw):
+        (length,) = np.frombuffer(raw[pos:pos + 4], ">u4")
+        tag = raw[pos + 4:pos + 8]
+        data = raw[pos + 8:pos + 8 + int(length)]
+        if tag == b"IHDR":
+            w, h = np.frombuffer(data[:8], ">u4")
+        elif tag == b"IDAT":
+            idat += data
+        pos += 12 + int(length)
+    decomp = zlib.decompress(idat)
+    rows = np.frombuffer(decomp, np.uint8).reshape(int(h), int(w) + 1)
+    assert (rows[:, 0] == 0).all()          # filter type None per row
+    return rows[:, 1:]
+
+
+def test_write_png_round_trip(tmp_path):
+    img = (np.arange(20 * 13) % 256).astype(np.uint8).reshape(20, 13)
+    path = write_png_gray(img, str(tmp_path / "t.png"))
+    np.testing.assert_array_equal(_decode_png_gray(path), img)
+
+
+def test_activation_grid_shape():
+    act = np.random.RandomState(0).rand(8, 6, 5).astype(np.float32)
+    grid = activation_grid(act)
+    # 5 channels -> 3x2 grid with 1px padding
+    assert grid.shape == (2 * 9 - 1, 3 * 7 - 1)
+    assert grid.dtype == np.uint8
+
+
+# ------------------------------------------------------------ legacy listeners
+
+def _fit_net(listeners, n_iters=12):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("sgd").learning_rate(0.1)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(*listeners)
+    rng = np.random.RandomState(0)
+    for _ in range(n_iters):
+        net.fit(DataSet(rng.randn(16, 4).astype(np.float32),
+                        np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]))
+    return net
+
+
+def test_histogram_listener_collects_and_renders(tmp_path):
+    listener = HistogramIterationListener(frequency=2)
+    _fit_net([listener])
+    assert listener.scores
+    assert "0_W" in listener.histograms
+    assert "0_W" in listener.update_histograms  # needs two samples
+    path = listener.render(str(tmp_path / "hist.html"))
+    content = open(path).read()
+    assert "<svg" in content and "param 0_W" in content
+
+
+def test_conv_listener_writes_activation_pngs(tmp_path):
+    from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                          SubsamplingLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater("sgd").learning_rate(0.05)
+            .activation("relu").weight_init("xavier").list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(inputs.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(1)
+    probe = rng.rand(2, 8, 8, 1).astype(np.float32)
+    listener = ConvolutionalIterationListener(
+        probe, frequency=2, output_dir=str(tmp_path / "acts"))
+    net.set_listeners(listener)
+    for _ in range(4):
+        net.fit(DataSet(rng.rand(8, 8, 8, 1).astype(np.float32),
+                        np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]))
+    assert listener.written
+    img = _decode_png_gray(listener.written[0])
+    assert img.ndim == 2 and img.size > 0
+
+
+# ------------------------------------------------------------- t-SNE module
+
+def test_tsne_module_round_trip():
+    server = UIServer(port=0).start()
+    try:
+        coords = np.random.RandomState(2).randn(10, 2)
+        labels = [f"w{i}" for i in range(10)]
+        server.set_tsne_data(coords, labels)
+        base = f"http://127.0.0.1:{server.port}"
+        page = urllib.request.urlopen(base + "/tsne").read().decode()
+        assert "t-SNE" in page
+        data = json.loads(
+            urllib.request.urlopen(base + "/tsne/data").read())
+        assert len(data["coords"]) == 10
+        assert data["labels"][0] == "w0"
+        # remote upload path
+        body = json.dumps({"coords": [[0, 0], [1, 1]],
+                           "labels": ["a", "b"]}).encode()
+        req = urllib.request.Request(
+            base + "/tsne/upload", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req).read()
+        data = json.loads(
+            urllib.request.urlopen(base + "/tsne/data").read())
+        assert data["labels"] == ["a", "b"]
+    finally:
+        server.stop()
+
+
+def test_tsne_rejects_bad_coords():
+    server = UIServer(port=0)
+    with pytest.raises(ValueError):
+        server.set_tsne_data(np.zeros(5))
+
+
+def test_tsne_empty_coords_clears():
+    server = UIServer(port=0)
+    server.set_tsne_data(np.random.randn(4, 2))
+    server.set_tsne_data([])
+    assert server.tsne_data()["coords"] == []
+
+
+def test_post_error_responses():
+    """Unknown POST paths 404 even with an empty body; malformed uploads
+    get a 400, not a dropped connection."""
+    import urllib.error
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(base + "/nope", data=b"",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 404
+        req = urllib.request.Request(
+            base + "/tsne/upload", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+        # bad coords shape -> 400 as well
+        req = urllib.request.Request(
+            base + "/tsne/upload",
+            data=json.dumps({"coords": [1, 2, 3]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+    finally:
+        server.stop()
