@@ -1,0 +1,127 @@
+"""Parallelism utilities.
+
+TPU-native equivalents of the reference's ``deeplearning4j-core``
+``parallelism/`` package:
+
+- :class:`AsyncIterator` — background-thread prefetch over ANY Python
+  iterator (reference ``AsyncIterator.java``): the producer fills a
+  bounded queue, the consumer never blocks on upstream latency until the
+  buffer drains.  (``datasets/iterators.AsyncDataSetIterator`` is the
+  DataSet-specific variant with ``reset()``; this is the generic one.)
+- :class:`MagicQueue` — device-aware multi-queue (reference
+  ``MagicQueue.java``): one bounded sub-queue per device, round-robin
+  ``put`` distribution, per-device ``poll``.  The reference uses it to
+  keep each GPU's host-side feed independent; here it plays the same role
+  for per-replica host feeds (the JAX device handle is just the key — no
+  affinity API is needed because placement happens at ``device_put``
+  time).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, List, Optional
+
+_SENTINEL = object()
+
+
+class AsyncIterator:
+    """Prefetching wrapper over an iterator (reference
+    ``parallelism/AsyncIterator.java``)."""
+
+    def __init__(self, iterator: Iterable, queue_size: int = 8):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, queue_size))
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(iterator),), daemon=True)
+        self._thread.start()
+
+    def _produce(self, it: Iterator) -> None:
+        try:
+            for item in it:
+                self._queue.put(item)
+        except BaseException as e:      # surface upstream errors on next()
+            self._exc = e
+        finally:
+            self._queue.put(_SENTINEL)
+
+    def __iter__(self) -> "AsyncIterator":
+        return self
+
+    def __next__(self):
+        if getattr(self, "_done", False):
+            # keep raising after exhaustion — the sentinel arrives only once
+            raise StopIteration
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._done = True
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def shutdown(self) -> None:
+        """Drain so the producer thread can finish (best effort)."""
+        try:
+            while self._queue.get_nowait() is not _SENTINEL:
+                pass
+        except queue.Empty:
+            pass
+
+
+class MagicQueue:
+    """Per-device bounded sub-queues with round-robin distribution
+    (reference ``parallelism/MagicQueue.java``).
+
+    ``put(item)`` round-robins across devices; ``put(item, device)`` pins;
+    ``poll(device)`` / ``poll(device, timeout)`` pulls that device's feed.
+    ``size()`` is the total number of queued items.
+    """
+
+    def __init__(self, devices: Optional[List[Any]] = None,
+                 capacity_per_device: int = 8):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        if not devices:
+            raise ValueError("MagicQueue needs at least one device")
+        self._devices = list(devices)
+        self._queues = {self._key(d): queue.Queue(
+            maxsize=max(1, capacity_per_device)) for d in self._devices}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(device) -> Any:
+        return device if isinstance(device, (int, str)) else id(device)
+
+    @property
+    def devices(self) -> List[Any]:
+        return list(self._devices)
+
+    def put(self, item, device=None, timeout: Optional[float] = None
+            ) -> None:
+        if device is None:
+            with self._lock:
+                device = self._devices[self._rr % len(self._devices)]
+                self._rr += 1
+        self._queues[self._key(device)].put(item, timeout=timeout)
+
+    def poll(self, device, timeout: Optional[float] = None):
+        """Next item for ``device``; None if empty (after ``timeout``)."""
+        q = self._queues[self._key(device)]
+        try:
+            if timeout is None:
+                return q.get_nowait()
+            return q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def size(self, device=None) -> int:
+        if device is not None:
+            return self._queues[self._key(device)].qsize()
+        return sum(q.qsize() for q in self._queues.values())
+
+    def is_empty(self) -> bool:
+        return self.size() == 0

@@ -1,0 +1,219 @@
+"""LFW / Curves fetchers + parallelism utils tests (reference
+``LFWDataSetIteratorTest``, curves fetcher usage in pretrain examples,
+``AsyncIteratorTest``, ``MagicQueueTest``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator, curves_arrays
+from deeplearning4j_tpu.datasets.lfw import (LFWDataSetIterator, _read_pnm,
+                                             lfw_arrays)
+from deeplearning4j_tpu.utils.parallelism import AsyncIterator, MagicQueue
+
+
+# ------------------------------------------------------------------- LFW
+
+def test_lfw_procedural_shapes_and_determinism():
+    x, y, names = lfw_arrays(num_examples=40, num_labels=5,
+                             image_shape=(32, 32, 1), seed=3)
+    assert x.shape == (40, 32, 32, 1) and y.shape == (40, 5)
+    assert x.min() >= 0 and x.max() <= 1
+    assert len(names) == 5
+    x2, y2, _ = lfw_arrays(num_examples=40, num_labels=5,
+                           image_shape=(32, 32, 1), seed=3)
+    np.testing.assert_array_equal(x, x2)
+
+
+def test_lfw_same_person_more_similar_than_cross():
+    """Identity must be visually consistent: two renders of the same person
+    correlate more than renders of different people (averaged)."""
+    x, y, _ = lfw_arrays(num_examples=200, num_labels=4,
+                         image_shape=(32, 32, 1), seed=5)
+    ids = y.argmax(1)
+    flat = x.reshape(len(x), -1)
+    same, cross = [], []
+    for i in range(0, 60):
+        for j in range(i + 1, 60):
+            d = np.linalg.norm(flat[i] - flat[j])
+            (same if ids[i] == ids[j] else cross).append(d)
+    assert np.mean(same) < np.mean(cross)
+
+
+def test_lfw_train_test_share_identities():
+    """train=False renders different photos of the SAME people: a nearest-
+    centroid classifier fit on train must beat chance on test."""
+    xtr, ytr, _ = lfw_arrays(60, 3, (24, 24, 1), seed=7)
+    xte, yte, _ = lfw_arrays(60, 3, (24, 24, 1), seed=7 + 999_331,
+                             identity_seed=7)
+    centroids = np.stack([
+        xtr[ytr.argmax(1) == c].reshape(-1, 24 * 24).mean(0)
+        for c in range(3)])
+    pred = np.argmin(np.linalg.norm(
+        xte.reshape(-1, 24 * 24)[:, None] - centroids[None], axis=2), 1)
+    assert (pred == yte.argmax(1)).mean() > 0.6
+
+
+def test_lfw_iterator_batches():
+    it = LFWDataSetIterator(batch=16, num_examples=48, num_labels=3,
+                            image_shape=(24, 24, 1))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (16, 24, 24, 1)
+    assert len(it.get_labels()) == 3
+
+
+def test_lfw_real_mode_pnm_tree(tmp_path, monkeypatch):
+    """Real-mode loads a person-per-directory PGM tree with the reference's
+    directory->label mapping."""
+    for pid, person in enumerate(["alice", "bob"]):
+        d = tmp_path / person
+        d.mkdir()
+        for k in range(3):
+            img = np.full((10, 8), 40 * (pid + 1) + k, np.uint8)
+            header = f"P5\n8 10\n255\n".encode()
+            (d / f"img{k}.pgm").write_bytes(header + img.tobytes())
+    monkeypatch.setenv("LFW_DIR", str(tmp_path))
+    x, y, names = lfw_arrays(num_examples=6, image_shape=(10, 8, 1))
+    assert names == ["alice", "bob"]
+    assert x.shape == (6, 10, 8, 1)
+    # alice's images come first (sorted dirs) with label 0
+    assert y[:3].argmax(1).tolist() == [0, 0, 0]
+    assert abs(float(x[0, 0, 0, 0]) - 40 / 255.0) < 1e-6
+
+
+def test_lfw_real_mode_caps_people_at_num_labels(tmp_path, monkeypatch):
+    for person in ["a", "b", "c"]:
+        d = tmp_path / person
+        d.mkdir()
+        (d / "x.pgm").write_bytes(b"P5\n4 4\n255\n" + bytes(16))
+    monkeypatch.setenv("LFW_DIR", str(tmp_path))
+    x, y, names = lfw_arrays(num_examples=10, num_labels=2,
+                             image_shape=(4, 4, 1))
+    assert names == ["a", "b"]
+    assert y.shape[1] == 2
+
+
+def test_read_pnm_with_comment(tmp_path):
+    img = np.arange(12, dtype=np.uint8).reshape(3, 4)
+    (tmp_path / "c.pgm").write_bytes(
+        b"P5\n# a comment\n4 3\n255\n" + img.tobytes())
+    out = _read_pnm(str(tmp_path / "c.pgm"))
+    np.testing.assert_array_equal(out[:, :, 0], img)
+
+
+# ----------------------------------------------------------------- Curves
+
+def test_curves_shapes_and_reconstruction_labels():
+    x, y = curves_arrays(num_examples=20, seed=1)
+    assert x.shape == (20, 784)
+    np.testing.assert_array_equal(x, y)
+    assert x.max() <= 1.0 and x.min() >= 0.0
+    # curves are sparse strokes: most pixels dark, some bright
+    assert (x > 0.5).mean() < 0.25
+    assert (x > 0.5).any()
+
+
+def test_curves_iterator():
+    it = CurvesDataSetIterator(batch=10, num_samples=30)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0].features, batches[0].labels)
+
+
+def test_curves_pretrain_autoencoder_smoke():
+    """The reference's use case: unsupervised pretraining on curves."""
+    from deeplearning4j_tpu.nn.conf import inputs
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.pretrain import AutoEncoder
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).updater("sgd").learning_rate(0.1)
+            .activation("sigmoid").weight_init("xavier")
+            .list()
+            .layer(AutoEncoder(n_out=32))
+            .layer(OutputLayer(n_out=784, activation="sigmoid", loss="mse"))
+            .set_input_type(inputs.feed_forward(784))
+            .pretrain(True)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain(CurvesDataSetIterator(batch=25, num_samples=100), epochs=1)
+
+
+# ------------------------------------------------------------ AsyncIterator
+
+def test_async_iterator_yields_all_in_order():
+    out = list(AsyncIterator(range(50), queue_size=4))
+    assert out == list(range(50))
+
+
+def test_async_iterator_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = AsyncIterator(gen())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_async_iterator_exhaustion_is_sticky():
+    it = AsyncIterator(range(3))
+    assert list(it) == [0, 1, 2]
+    with pytest.raises(StopIteration):   # must not deadlock
+        next(it)
+    assert list(it) == []
+
+
+def test_async_iterator_prefetches_in_background():
+    produced = []
+
+    def slow_gen():
+        for i in range(5):
+            produced.append(i)
+            yield i
+
+    it = AsyncIterator(slow_gen(), queue_size=8)
+    time.sleep(0.2)
+    assert len(produced) == 5          # fully prefetched before consumption
+    assert list(it) == [0, 1, 2, 3, 4]
+
+
+# --------------------------------------------------------------- MagicQueue
+
+def test_magic_queue_round_robin_and_poll():
+    q = MagicQueue(devices=["d0", "d1", "d2"])
+    for i in range(6):
+        q.put(i)
+    assert q.size() == 6
+    assert q.size("d0") == 2
+    assert q.poll("d0") == 0
+    assert q.poll("d1") == 1
+    assert q.poll("d2") == 2
+    assert q.poll("d0") == 3
+    assert q.poll("d0") is None        # drained
+    assert not q.is_empty()
+
+
+def test_magic_queue_pinned_put_and_timeout():
+    q = MagicQueue(devices=["a", "b"])
+    q.put("x", device="b")
+    assert q.poll("a") is None
+    assert q.poll("b", timeout=0.1) == "x"
+    t0 = time.perf_counter()
+    assert q.poll("b", timeout=0.1) is None
+    assert time.perf_counter() - t0 >= 0.09
+
+
+def test_magic_queue_real_devices():
+    import jax
+    q = MagicQueue()                   # defaults to jax.devices()
+    dev = q.devices[0]
+    q.put({"batch": 1})
+    # round-robin starts at device 0
+    assert q.poll(dev) == {"batch": 1}
