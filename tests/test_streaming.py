@@ -1,0 +1,224 @@
+"""Streaming pipeline tests (reference dl4j-streaming test patterns: the
+embedded-Kafka pipeline tests, record conversion, online predict/fit)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.streaming import (CsvRecordConverter,
+                                          DictRecordConverter,
+                                          FileTailRecordSource,
+                                          InMemoryRecordSource,
+                                          SocketRecordSource,
+                                          StreamingPipeline)
+
+
+def _net(n_in=4, n_classes=3, seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater("sgd").learning_rate(0.2)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=n_classes))
+            .set_input_type(inputs.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _wait(predicate, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------------- converters
+
+def test_csv_converter_labeled_and_unlabeled():
+    c = CsvRecordConverter(label_index=-1, num_classes=3)
+    f, l = c.convert("0.5, 1.0, -2.0, 2")
+    np.testing.assert_allclose(f, [0.5, 1.0, -2.0])
+    np.testing.assert_array_equal(l, [0, 0, 1])
+    c2 = CsvRecordConverter(label_index=None)
+    f, l = c2.convert("1,2,3")
+    assert l is None and f.shape == (3,)
+
+
+def test_csv_converter_requires_num_classes():
+    with pytest.raises(ValueError):
+        CsvRecordConverter(label_index=0)
+
+
+def test_dict_converter_json_strings():
+    c = DictRecordConverter(num_classes=2)
+    f, l = c.convert(json.dumps({"features": [1, 2], "label": 1}))
+    np.testing.assert_array_equal(l, [0, 1])
+    f, l = c.convert({"features": [3, 4]})
+    assert l is None
+
+
+# ---------------------------------------------------------------- sources
+
+def test_file_tail_source_follows_appends(tmp_path):
+    path = str(tmp_path / "stream.csv")
+    open(path, "w").write("1,2\n")
+    src = FileTailRecordSource(path)
+    assert src.poll(timeout=1.0) == "1,2"
+    assert src.poll(timeout=0.1) is None
+    with open(path, "a") as f:
+        f.write("3,4\n")
+    assert src.poll(timeout=1.0) == "3,4"
+    src.close()
+
+
+def test_socket_source_receives_lines():
+    src = SocketRecordSource(port=0)
+    try:
+        SocketRecordSource.send(src.host, src.port, ["a,b", "c,d"])
+        assert src.poll(timeout=2.0) == "a,b"
+        assert src.poll(timeout=2.0) == "c,d"
+    finally:
+        src.close()
+
+
+# --------------------------------------------------------------- pipeline
+
+def test_pipeline_online_predictions():
+    net = _net()
+    src = InMemoryRecordSource()
+    preds = []
+    pipe = StreamingPipeline(
+        net, src, CsvRecordConverter(label_index=None), mode="predict",
+        batch_size=4, flush_interval=0.1,
+        on_prediction=lambda x, out: preds.append((x, out)))
+    rng = np.random.RandomState(0)
+    rows = [",".join(f"{v:.4f}" for v in rng.randn(4)) for _ in range(10)]
+    with pipe:
+        src.offer_all(rows)
+        assert _wait(lambda: pipe.records_processed >= 10)
+        assert _wait(lambda: sum(len(p[1]) for p in preds) >= 10)
+    total = sum(len(p[1]) for p in preds)
+    assert total == 10                 # padded rows must NOT leak out
+    for x, out in preds:
+        assert out.shape[1] == 3
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+    assert not pipe.errors
+
+
+def test_pipeline_online_fit_learns():
+    """Online training on a linearly separable stream reduces loss."""
+    net = _net(n_in=2, n_classes=2)
+    src = InMemoryRecordSource()
+    pipe = StreamingPipeline(net, src,
+                             CsvRecordConverter(label_index=-1,
+                                                num_classes=2),
+                             mode="fit", batch_size=16, flush_interval=0.1)
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 2)
+    y = (X[:, 0] > 0).astype(int)
+    probe = DataSet(X[:100].astype(np.float32),
+                    np.eye(2, dtype=np.float32)[y[:100]])
+    before = float(net.score(probe))
+    rows = [f"{a:.4f},{b:.4f},{int(c)}" for (a, b), c in zip(X, y)]
+    with pipe:
+        src.offer_all(rows)
+        assert _wait(lambda: pipe.records_processed >= 400)
+        assert _wait(lambda: pipe.batches_processed >= 20)
+    after = float(net.score(probe))
+    assert after < before * 0.8, (before, after)
+    assert not pipe.errors
+
+
+def test_pipeline_socket_end_to_end():
+    net = _net(n_in=2, n_classes=2)
+    src = SocketRecordSource(port=0)
+    outs = []
+    pipe = StreamingPipeline(
+        net, src, DictRecordConverter(num_classes=2), mode="predict",
+        batch_size=2, flush_interval=0.1,
+        on_prediction=lambda x, o: outs.append(o))
+    with pipe:
+        SocketRecordSource.send(src.host, src.port, [
+            json.dumps({"features": [0.1, 0.2]}),
+            json.dumps({"features": [0.3, 0.4]}),
+            json.dumps({"features": [0.5, 0.6]}),
+        ])
+        assert _wait(lambda: sum(map(len, outs)) >= 3)
+    src.close()
+    assert not pipe.errors
+
+
+def test_pipeline_poison_records_counted_not_fatal():
+    net = _net()
+    src = InMemoryRecordSource()
+    pipe = StreamingPipeline(net, src,
+                             CsvRecordConverter(label_index=None),
+                             mode="predict", batch_size=2,
+                             flush_interval=0.05)
+    with pipe:
+        src.offer("not,a,number,row,xyz")
+        src.offer("0.1,0.2,0.3,0.4")
+        src.offer("0.5,0.6,0.7,0.8")
+        assert _wait(lambda: pipe.records_processed >= 2)
+    assert len(pipe.errors) == 1
+    assert pipe.records_processed == 2
+
+
+def test_file_tail_multibyte_partial_line(tmp_path):
+    """A partial line with multibyte UTF-8 must rewind by bytes, then
+    parse cleanly once the newline arrives."""
+    path = str(tmp_path / "s.txt")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("café,1")          # no newline yet
+    src = FileTailRecordSource(path)
+    assert src.poll(timeout=0.1) is None
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\ncafé,2\n")
+    assert src.poll(timeout=1.0) == "café,1"
+    assert src.poll(timeout=1.0) == "café,2"
+    src.close()
+
+
+def test_csv_converter_rejects_out_of_range_label_index():
+    c = CsvRecordConverter(label_index=5, num_classes=2)
+    with pytest.raises(ValueError, match="out of range"):
+        c.convert("1,2,3,0")
+    c2 = CsvRecordConverter(label_index=-1, num_classes=2)
+    with pytest.raises(ValueError):
+        c2.convert("1,2,-1")           # negative class label
+
+
+def test_pipeline_callback_error_does_not_cancel_fit():
+    net = _net(n_in=2, n_classes=2)
+    src = InMemoryRecordSource()
+
+    def bad_callback(x, out):
+        raise RuntimeError("callback boom")
+
+    pipe = StreamingPipeline(net, src,
+                             CsvRecordConverter(label_index=-1,
+                                                num_classes=2),
+                             mode="both", batch_size=4,
+                             flush_interval=0.05,
+                             on_prediction=bad_callback)
+    with pipe:
+        src.offer_all([f"{i*0.1:.2f},{i*0.2:.2f},{i%2}" for i in range(8)])
+        assert _wait(lambda: pipe.batches_processed >= 2)
+    assert len(pipe.errors) >= 2       # callback errors recorded
+    assert pipe.batches_processed >= 2  # but batches still trained
+
+
+def test_pipeline_rejects_bad_mode():
+    with pytest.raises(ValueError):
+        StreamingPipeline(_net(), InMemoryRecordSource(),
+                          CsvRecordConverter(label_index=None),
+                          mode="stream")
