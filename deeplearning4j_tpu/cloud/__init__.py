@@ -1,0 +1,28 @@
+"""Cloud storage + cluster provisioning tier.
+
+TPU-native equivalent of the reference's ``deeplearning4j-aws`` module
+(``aws/s3/uploader/S3Uploader.java``, ``aws/s3/reader/S3Downloader.java``
++ ``BaseS3DataSetIterator``, ``aws/ec2/provision/ClusterSetup.java`` /
+``Ec2BoxCreator.java`` / ``HostProvisioner.java``):
+
+- :class:`CloudStorage` SPI with a local-filesystem backend (always
+  available) and gcs/s3 backends gated on their SDKs (not in this image;
+  constructing them raises with install guidance — the stub-or-gate
+  policy).
+- :class:`RemoteDataSetIterator` — streams exported ``.npz`` minibatches
+  from a storage URI (the ``BaseS3DataSetIterator`` role), downloading
+  through a bounded local cache.
+- :class:`TpuPodProvisioner` — the EC2-cluster-bootstrap role rebased
+  onto TPU pods: emits per-host launch scripts/environment
+  (``jax.distributed`` coordinator address, process ids/counts) instead
+  of spinning EC2 boxes over SSH.
+"""
+
+from .provision import TpuPodProvisioner
+from .storage import (CloudStorage, LocalFilesystemStorage,
+                      RemoteDataSetIterator, get_storage)
+
+__all__ = [
+    "CloudStorage", "LocalFilesystemStorage", "RemoteDataSetIterator",
+    "get_storage", "TpuPodProvisioner",
+]

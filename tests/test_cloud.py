@@ -1,0 +1,155 @@
+"""Cloud storage + provisioning tests (reference deeplearning4j-aws:
+S3 up/download, BaseS3DataSetIterator, ClusterSetup)."""
+
+import json
+import os
+import stat
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud import (LocalFilesystemStorage,
+                                      RemoteDataSetIterator,
+                                      TpuPodProvisioner, get_storage)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.scaleout.data import batch_and_export
+
+
+# ----------------------------------------------------------------- storage
+
+def test_local_storage_round_trip(tmp_path):
+    st = LocalFilesystemStorage()
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    uri = f"file://{tmp_path}/bucket/key/a.txt"
+    st.upload(str(src), uri)
+    assert st.exists(uri)
+    dest = tmp_path / "b.txt"
+    st.download(uri, str(dest))
+    assert dest.read_text() == "hello"
+    listed = st.list(f"file://{tmp_path}/bucket")
+    assert listed == [uri]
+    st.delete(uri)
+    assert not st.exists(uri)
+
+
+def test_get_storage_selects_backend(tmp_path):
+    assert isinstance(get_storage(str(tmp_path)), LocalFilesystemStorage)
+    with pytest.raises((ImportError, NotImplementedError)):
+        get_storage("s3://bucket/key")
+    with pytest.raises((ImportError, NotImplementedError)):
+        get_storage("gs://bucket/key")
+
+
+def test_remote_dataset_iterator(tmp_path):
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(8, 3).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)])
+               for _ in range(5)]
+    export_dir = str(tmp_path / "export")
+    batch_and_export(batches, export_dir, batch_size=8)
+    cache = str(tmp_path / "cache")
+    it = RemoteDataSetIterator(export_dir, cache_dir=cache)
+    out = list(it)
+    assert len(out) == 5
+    assert out[0].features.shape == (8, 3)
+    # second pass hits the cache (files already downloaded)
+    it.reset()
+    assert len(list(it)) == 5
+    assert len(os.listdir(cache)) == 5
+
+
+def test_remote_iterator_empty_prefix_raises(tmp_path):
+    with pytest.raises(ValueError):
+        RemoteDataSetIterator(str(tmp_path))
+
+
+# ------------------------------------------------------------- provisioning
+
+def test_provisioner_env_matches_dcn_contract():
+    p = TpuPodProvisioner(4, "10.0.0.1", command="python train.py")
+    env = p.host_env(2)
+    assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+    assert env["NUM_PROCESSES"] == "4"
+    assert env["PROCESS_ID"] == "2"
+    with pytest.raises(ValueError):
+        p.host_env(4)
+
+
+def test_provisioner_write_scripts(tmp_path):
+    p = TpuPodProvisioner(2, "host0", coordinator_port=9999,
+                          command="python -m train",
+                          env={"EXTRA": "1"})
+    paths = p.write(str(tmp_path / "out"))
+    assert len(paths) == 3             # cluster.json + 2 scripts
+    spec = json.loads(open(paths[0]).read())
+    assert spec["num_processes"] == 2
+    assert spec["hosts"][1]["env"]["PROCESS_ID"] == "1"
+    script = open(paths[2]).read()
+    assert "export COORDINATOR_ADDRESS=host0:9999" in script
+    assert "export EXTRA=1" in script
+    assert script.rstrip().endswith("exec python -m train")
+    assert os.stat(paths[1]).st_mode & stat.S_IXUSR
+
+
+def test_provisioner_shell_quotes_hostile_env():
+    p = TpuPodProvisioner(1, "h", env={"TOKEN": "a'b$HOME`x`"})
+    script = p.launch_script(0)
+    import shlex
+    assert f"export TOKEN={shlex.quote(chr(97)+chr(39)+'b$HOME`x`')}" \
+        in script
+
+
+def test_get_storage_rejects_unknown_scheme():
+    with pytest.raises(ValueError, match="unsupported storage scheme"):
+        get_storage("hdfs://bucket/data")
+
+
+def test_remote_iterator_no_basename_collision(tmp_path):
+    """Same-named objects in different prefixes must cache separately."""
+    rng = np.random.RandomState(1)
+    for shard, val in (("shard0", 0.0), ("shard1", 1.0)):
+        d = tmp_path / "data" / shard
+        d.mkdir(parents=True)
+        np.savez(d / "dataset_0.npz",
+                 features=np.full((4, 2), val, np.float32),
+                 labels=np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+    it = RemoteDataSetIterator(str(tmp_path / "data"),
+                               cache_dir=str(tmp_path / "cache"))
+    out = list(it)
+    assert len(out) == 2
+    vals = sorted(float(np.asarray(b.features)[0, 0]) for b in out)
+    assert vals == [0.0, 1.0]
+
+
+def test_remote_iterator_batch_does_not_consume(tmp_path):
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(8, 3).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)])
+               for _ in range(3)]
+    batch_and_export(batches, str(tmp_path / "e"), batch_size=8)
+    it = RemoteDataSetIterator(str(tmp_path / "e"))
+    it.reset()
+    next(it)
+    next(it)
+    assert it.batch() == 8
+    assert it._pos == 2                # batch() must not move the cursor
+    next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_provisioner_env_drives_dcn_initialize(monkeypatch):
+    """The emitted env is exactly what scaleout.dcn.initialize_from_env
+    reads (single-host degenerate case actually initializes)."""
+    from deeplearning4j_tpu.scaleout import dcn
+    p = TpuPodProvisioner(1, "127.0.0.1")
+    for k, v in p.host_env(0).items():
+        monkeypatch.setenv(k, v)
+    # NUM_PROCESSES=1: initialize_from_env must accept the env shape;
+    # jax.distributed with one process either initializes or is a no-op,
+    # but the env contract parse must not raise.
+    try:
+        dcn.initialize_from_env()
+    except RuntimeError:
+        pass  # jax.distributed may refuse re-init in-process; parse is the contract

@@ -1,0 +1,179 @@
+"""Keras bridge tests (reference deeplearning4j-keras:
+DeepLearning4jEntryPoint fit over HDF5 minibatch dirs, via the RPC
+server)."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.bridge import (HDF5MiniBatchDataSetIterator,
+                                             KerasBridgeClient,
+                                             KerasBridgeEntryPoint,
+                                             KerasBridgeServer)
+
+
+def _write_keras1_h5(path, model_config, layer_weights):
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        g = f.create_group("model_weights")
+        for layer_name, weights in layer_weights.items():
+            lg = g.create_group(layer_name)
+            names = []
+            for wname, arr in weights.items():
+                full = f"{layer_name}_{wname}"
+                lg.create_dataset(full, data=np.asarray(arr, np.float32))
+                names.append(full.encode())
+            lg.attrs["weight_names"] = names
+
+
+def _mlp_h5(path, seed=0, n_in=4, hidden=8, n_out=3):
+    r = np.random.RandomState(seed)
+    conf = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": hidden,
+                    "activation": "tanh",
+                    "batch_input_shape": [None, n_in]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "output_dim": n_out,
+                    "activation": "softmax"}},
+    ]}
+    _write_keras1_h5(path, conf, {
+        "dense_1": {"W": r.randn(n_in, hidden) * 0.3, "b": np.zeros(hidden)},
+        "dense_2": {"W": r.randn(hidden, n_out) * 0.3, "b": np.zeros(n_out)},
+    })
+    return path
+
+
+def _minibatch_dir(tmp_path, n_batches=4, batch=16, n_in=4, n_out=3,
+                   seed=1):
+    d = tmp_path / "batches"
+    d.mkdir()
+    r = np.random.RandomState(seed)
+    for i in range(n_batches):
+        X = r.randn(batch, n_in).astype(np.float32)
+        y = np.eye(n_out, dtype=np.float32)[
+            (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)]
+        with h5py.File(d / f"batch_{i:04d}.h5", "w") as f:
+            f.create_dataset("features", data=X)
+            f.create_dataset("labels", data=y)
+    return str(d)
+
+
+# ---------------------------------------------------------------- iterator
+
+def test_hdf5_minibatch_iterator(tmp_path):
+    d = _minibatch_dir(tmp_path, n_batches=3, batch=8)
+    it = HDF5MiniBatchDataSetIterator(d)
+    assert it.batch() == 8
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (8, 4)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_hdf5_iterator_empty_dir_raises(tmp_path):
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(ValueError):
+        HDF5MiniBatchDataSetIterator(str(tmp_path / "empty"))
+
+
+# -------------------------------------------------------------- entry point
+
+def test_entry_point_sequential_fit_and_predict(tmp_path):
+    model = _mlp_h5(str(tmp_path / "m.h5"))
+    data = _minibatch_dir(tmp_path)
+    entry = KerasBridgeEntryPoint()
+    out = entry.sequential_fit(model, data, nb_epoch=2)
+    assert "model_id" in out and np.isfinite(out["score"])
+    pred = entry.predict(out["model_id"], [[0.1, 0.2, 0.3, 0.4]])
+    assert len(pred["output"][0]) == 3
+    ev = entry.evaluate(out["model_id"], data)
+    assert 0.0 <= ev["accuracy"] <= 1.0
+
+
+def test_entry_point_unknown_model_raises():
+    entry = KerasBridgeEntryPoint()
+    with pytest.raises(KeyError):
+        entry.predict("model_99", [[1.0]])
+
+
+# ------------------------------------------------------------------- server
+
+def test_bridge_server_end_to_end(tmp_path):
+    model = _mlp_h5(str(tmp_path / "m.h5"))
+    data = _minibatch_dir(tmp_path)
+    with KerasBridgeServer(port=0) as server:
+        client = KerasBridgeClient(server.host, server.port)
+        try:
+            imp = client.call("import_model", path=model)
+            mid = imp["model_id"]
+            fit = client.call("fit", model_id=mid, train_dir=data,
+                              nb_epoch=2)
+            assert np.isfinite(fit["score"])
+            pred = client.call("predict", model_id=mid,
+                               features=[[0.0, 0.1, -0.2, 0.3]])
+            np.testing.assert_allclose(np.sum(pred["output"][0]), 1.0,
+                                       rtol=1e-4)
+            save = client.call("save", model_id=mid,
+                               path=str(tmp_path / "saved.zip"))
+            assert (tmp_path / "saved.zip").exists()
+            # protocol errors surface as RuntimeError, connection survives
+            with pytest.raises(RuntimeError):
+                client.call("no_such_method")
+            with pytest.raises(RuntimeError):
+                client.call("predict", model_id="nope", features=[[1.0]])
+            pred2 = client.call("predict", model_id=mid,
+                                features=[[0.0, 0.0, 0.0, 0.0]])
+            assert len(pred2["output"]) == 1
+        finally:
+            client.close()
+
+
+def test_bridge_rejects_private_methods(tmp_path):
+    with KerasBridgeServer(port=0) as server:
+        client = KerasBridgeClient(server.host, server.port)
+        try:
+            with pytest.raises(RuntimeError, match="unknown method"):
+                client.call("_register")
+        finally:
+            client.close()
+
+
+def test_bridge_malformed_json_gets_error_response():
+    """A non-JSON line must produce an error reply, not a dropped
+    connection; the connection stays usable."""
+    import socket
+
+    with KerasBridgeServer(port=0) as server:
+        with socket.create_connection((server.host, server.port),
+                                      timeout=10) as s:
+            fh = s.makefile("rwb")
+            fh.write(b"this is not json\n")
+            fh.flush()
+            resp = json.loads(fh.readline())
+            assert "error" in resp and resp["id"] is None
+            fh.write((json.dumps({"id": 7, "method": "import_model",
+                                  "params": {"path": "/nope.h5"}})
+                      + "\n").encode())
+            fh.flush()
+            resp2 = json.loads(fh.readline())
+            assert resp2["id"] == 7 and "error" in resp2
+
+
+def test_bridge_concurrent_imports_get_unique_handles(tmp_path):
+    from concurrent.futures import ThreadPoolExecutor
+    model = _mlp_h5(str(tmp_path / "m.h5"))
+    with KerasBridgeServer(port=0) as server:
+        def one_import(_):
+            c = KerasBridgeClient(server.host, server.port)
+            try:
+                return c.call("import_model", path=model)["model_id"]
+            finally:
+                c.close()
+
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            handles = list(pool.map(one_import, range(6)))
+    assert len(set(handles)) == 6
