@@ -414,6 +414,8 @@ class GraphBuilder:
         if cgc.input_types is not None:
             _infer_graph_shapes(cgc)
         cgc.topological_order()  # validates acyclicity + unknown inputs
+        from .validation import validate_computation_graph_configuration
+        validate_computation_graph_configuration(cgc)
         return cgc
 
 

@@ -336,6 +336,8 @@ class ListBuilder:
             layer.finalize_defaults(defaults)
         if mlc.input_type is not None:
             _infer_shapes(mlc)
+        from .validation import validate_multi_layer_configuration
+        validate_multi_layer_configuration(mlc)
         return mlc
 
 

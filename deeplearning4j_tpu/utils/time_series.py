@@ -1,0 +1,92 @@
+"""Time-series shape/mask utilities.
+
+TPU-native equivalents of the reference's
+``util/TimeSeriesUtils.java`` (2d<->3d reshapes, mask<->vector) and the
+``text/movingwindow`` package's windowing role.  Layout note: this build
+is (batch, time, features) channels-last end to end (the reference is
+(batch, features, time)); the reshape semantics match per timestep."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def reshape_3d_to_2d(x) -> np.ndarray:
+    """(batch, time, features) -> (batch*time, features), time fastest
+    within a batch row (reference ``reshape3dTo2d``)."""
+    x = np.asarray(x)
+    if x.ndim != 3:
+        raise ValueError(f"expected 3-D time series, got {x.shape}")
+    return x.reshape(-1, x.shape[-1])
+
+
+def reshape_2d_to_3d(x, batch_size: int) -> np.ndarray:
+    """(batch*time, features) -> (batch, time, features) (reference
+    ``reshape2dTo3d``)."""
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"expected 2-D activations, got {x.shape}")
+    if x.shape[0] % batch_size:
+        raise ValueError(
+            f"rows {x.shape[0]} not divisible by batch {batch_size}")
+    return x.reshape(batch_size, x.shape[0] // batch_size, x.shape[-1])
+
+
+def reshape_time_series_mask_to_vector(mask) -> np.ndarray:
+    """(batch, time) mask -> (batch*time, 1) column (reference
+    ``reshapeTimeSeriesMaskToVector``) — the per-row weight vector used
+    when scoring flattened time-series output."""
+    mask = np.asarray(mask)
+    if mask.ndim != 2:
+        raise ValueError(f"expected (batch, time) mask, got {mask.shape}")
+    return mask.reshape(-1, 1)
+
+
+def reshape_vector_to_time_series_mask(vec, batch_size: int) -> np.ndarray:
+    """Inverse of :func:`reshape_time_series_mask_to_vector`."""
+    vec = np.asarray(vec).reshape(-1)
+    if vec.size % batch_size:
+        raise ValueError(
+            f"mask length {vec.size} not divisible by batch {batch_size}")
+    return vec.reshape(batch_size, vec.size // batch_size)
+
+
+def moving_window(sequence: Sequence, window_size: int,
+                  stride: int = 1) -> List[List]:
+    """Sliding windows over a token sequence (the ``text/movingwindow``
+    ``Window``/``Windows.windows`` role)."""
+    if window_size < 1 or stride < 1:
+        raise ValueError("window_size and stride must be >= 1")
+    seq = list(sequence)
+    if len(seq) < window_size:
+        return [seq] if seq else []
+    return [seq[i:i + window_size]
+            for i in range(0, len(seq) - window_size + 1, stride)]
+
+
+def pad_sequences(sequences: Sequence[np.ndarray],
+                  max_length: Optional[int] = None,
+                  value: float = 0.0):
+    """Pad variable-length (t_i, features) sequences to one
+    (batch, T, features) tensor + (batch, T) mask — the static-shape
+    bucketing XLA needs where the reference handles ragged INDArray time
+    axes directly (SURVEY.md §7 hard part c)."""
+    arrays = [np.asarray(s) for s in sequences]
+    if not arrays:
+        raise ValueError("no sequences")
+    if any(a.ndim != 2 for a in arrays):
+        raise ValueError("each sequence must be (time, features)")
+    T = max_length or max(a.shape[0] for a in arrays)
+    f = arrays[0].shape[1]
+    # promote across ALL sequences (and the pad value): an int-typed first
+    # sequence must not silently truncate float data
+    dtype = np.result_type(value, *arrays)
+    out = np.full((len(arrays), T, f), value, dtype)
+    mask = np.zeros((len(arrays), T), np.float32)
+    for i, a in enumerate(arrays):
+        t = min(a.shape[0], T)
+        out[i, :t] = a[:t]
+        mask[i, :t] = 1.0
+    return out, mask

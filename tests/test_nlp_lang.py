@@ -1,0 +1,118 @@
+"""Language-pack tests (reference deeplearning4j-nlp-japanese
+JapaneseTokenizerTest, -korean KoreanTokenizerTest, -uima
+UimaTokenizerFactoryTest patterns: tokenize sample text, feed a
+word2vec pipeline)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lang import (AnalysisEngine,
+                                         JapaneseTokenizerFactory,
+                                         KoreanTokenizerFactory,
+                                         SentenceAnnotator, TokenAnnotator,
+                                         UimaSentenceIterator,
+                                         UimaTokenizerFactory,
+                                         japanese_tokenize, korean_tokenize)
+from deeplearning4j_tpu.nlp.tokenization import LowCasePreProcessor
+
+
+# --------------------------------------------------------------- japanese
+
+def test_japanese_script_runs_and_particles():
+    # "I drink coffee at school" — 私は学校でコーヒーを飲みます
+    toks = japanese_tokenize("私は学校でコーヒーを飲みます")
+    assert "私" in toks            # kanji run
+    assert "は" in toks            # particle split from hiragana run
+    assert "学校" in toks          # kanji compound stays one token
+    assert "で" in toks
+    assert "コーヒー" in toks      # katakana run stays one token
+    assert "を" in toks
+    assert "ます" in toks          # polite auxiliary split
+
+
+def test_japanese_mixed_scripts_and_latin():
+    toks = japanese_tokenize("東京タワーはTokyo Towerです。高さ333メートル")
+    assert "東京" in toks and "タワー" in toks
+    assert "Tokyo" in toks and "Tower" in toks
+    assert "です" in toks
+    assert "333" in toks and "メートル" in toks
+
+
+def test_japanese_factory_spi():
+    f = JapaneseTokenizerFactory()
+    t = f.create("犬と猫")
+    assert t.get_tokens() == ["犬", "と", "猫"]
+    f.set_token_pre_processor(LowCasePreProcessor())
+    assert f.create("ABC犬").get_tokens() == ["abc", "犬"]
+
+
+# ----------------------------------------------------------------- korean
+
+def test_korean_josa_stripping():
+    # "the dog chases the cat" — 개가 고양이를 쫓는다
+    toks = korean_tokenize("개가 고양이를 쫓는다")
+    assert "개" in toks            # 가 stripped
+    assert "고양이" in toks        # 를 stripped
+    assert "쫓는다" in toks
+
+
+def test_korean_no_strip_mode_and_latin():
+    f = KoreanTokenizerFactory(strip_josa=False)
+    toks = f.create("서울에서 2024년").get_tokens()
+    assert "서울에서" in toks
+    assert "2024" in toks
+    f2 = KoreanTokenizerFactory()
+    assert "서울" in f2.create("서울에서").get_tokens()
+
+
+def test_korean_stem_never_emptied():
+    # a bare particle-like token must not strip to empty
+    assert korean_tokenize("은") == ["은"]
+
+
+# ------------------------------------------------------------------- uima
+
+def test_uima_token_annotator_pipeline():
+    f = UimaTokenizerFactory()
+    assert f.create("the quick fox").get_tokens() == ["the", "quick", "fox"]
+
+
+def test_uima_sentence_iterator():
+    docs = ["First sentence. Second one! Third?",
+            "これは文です。二つ目の文。"]
+    it = UimaSentenceIterator(docs)
+    sents = list(it)
+    assert sents[:3] == ["First sentence", "Second one", "Third"]
+    assert "これは文です" in sents
+    it.reset()
+    assert it.has_next()
+    assert it.next_sentence() == "First sentence"
+
+
+def test_uima_aggregate_engine_spans():
+    engine = AnalysisEngine([SentenceAnnotator(), TokenAnnotator()])
+    cas = engine.process("Hello world. Bye now.")
+    assert cas.covered("sentence") == ["Hello world", "Bye now"]
+    assert cas.covered("token") == ["Hello", "world.", "Bye", "now."]
+
+
+# ------------------------------------------- end-to-end embedding pipeline
+
+def test_japanese_word2vec_pipeline():
+    """Language-pack tokenizers plug into the Word2Vec SPI (the reference
+    tests Kuromoji by training vectors on Japanese text)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    rng = np.random.RandomState(0)
+    animals = ["犬", "猫", "馬"]
+    foods = ["寿司", "ラーメン", "パン"]
+    sentences = []
+    for _ in range(120):
+        group = animals if rng.rand() < 0.5 else foods
+        words = rng.choice(group, 4)
+        sentences.append("と".join(words) + "です")
+    w2v = Word2Vec(tokenizer_factory=JapaneseTokenizerFactory(),
+                   layer_size=12, window_size=3, min_word_frequency=1,
+                   negative=5.0, use_hierarchic_softmax=False,
+                   batch_size=128, seed=5, learning_rate=0.05)
+    w2v.fit(sentences)
+    assert w2v.has_word("犬") and w2v.has_word("寿司")
+    assert w2v.similarity("犬", "猫") > w2v.similarity("犬", "寿司")

@@ -1,0 +1,155 @@
+"""TimeSeriesUtils + LayerValidation tests (reference
+``util/TimeSeriesUtils``, ``util/LayerValidation`` usage)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.validation import validate_layer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.utils.time_series import (
+    moving_window, pad_sequences, reshape_2d_to_3d, reshape_3d_to_2d,
+    reshape_time_series_mask_to_vector, reshape_vector_to_time_series_mask)
+
+
+# --------------------------------------------------------- TimeSeriesUtils
+
+def test_reshape_3d_2d_round_trip():
+    x = np.arange(2 * 3 * 4).reshape(2, 3, 4).astype(np.float32)
+    flat = reshape_3d_to_2d(x)
+    assert flat.shape == (6, 4)
+    np.testing.assert_array_equal(reshape_2d_to_3d(flat, 2), x)
+
+
+def test_reshape_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        reshape_3d_to_2d(np.zeros((2, 3)))
+    with pytest.raises(ValueError):
+        reshape_2d_to_3d(np.zeros((7, 3)), 2)
+
+
+def test_mask_vector_round_trip():
+    m = (np.random.RandomState(0).rand(3, 5) > 0.5).astype(np.float32)
+    vec = reshape_time_series_mask_to_vector(m)
+    assert vec.shape == (15, 1)
+    np.testing.assert_array_equal(
+        reshape_vector_to_time_series_mask(vec, 3), m)
+
+
+def test_moving_window():
+    assert moving_window([1, 2, 3, 4, 5], 3) == [[1, 2, 3], [2, 3, 4],
+                                                 [3, 4, 5]]
+    assert moving_window([1, 2, 3, 4, 5], 2, stride=2) == [[1, 2], [3, 4]]
+    assert moving_window([1, 2], 5) == [[1, 2]]   # short sequence kept
+    assert moving_window([], 3) == []
+    with pytest.raises(ValueError):
+        moving_window([1], 0)
+
+
+def test_pad_sequences():
+    seqs = [np.ones((2, 3)), np.ones((4, 3)) * 2]
+    out, mask = pad_sequences(seqs)
+    assert out.shape == (2, 4, 3)
+    np.testing.assert_array_equal(mask, [[1, 1, 0, 0], [1, 1, 1, 1]])
+    assert float(out[0, 3, 0]) == 0.0
+    out2, mask2 = pad_sequences(seqs, max_length=3)
+    assert out2.shape == (2, 3, 3)
+    assert mask2[1].sum() == 3        # truncated
+
+
+# --------------------------------------------------------- LayerValidation
+
+def test_validate_layer_shape_and_dropout():
+    with pytest.raises(ValueError, match="n_out must be positive"):
+        validate_layer(DenseLayer(n_in=4, n_out=0))
+    with pytest.raises(ValueError, match="dropout"):
+        validate_layer(DenseLayer(n_in=4, n_out=2, dropout=1.5))
+    with pytest.raises(ValueError, match="l2 must be >= 0"):
+        validate_layer(DenseLayer(n_in=4, n_out=2, l2=-0.1))
+    validate_layer(DenseLayer(n_in=4, n_out=2, dropout=0.5, l2=0.01))
+
+
+def test_validate_unknown_activation_fails_at_build():
+    with pytest.raises((ValueError, KeyError)):
+        (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=4, activation="not_an_activation"))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(inputs.feed_forward(3))
+         .build())
+
+
+def test_validate_missing_n_out_fails_at_build_with_input_type():
+    with pytest.raises(ValueError, match="n_out must be positive"):
+        (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer())                 # n_out never set
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(inputs.feed_forward(3))
+         .build())
+
+
+def test_validate_deferred_without_input_type():
+    """No input type declared -> shape inference (and its n_out check) is
+    deferred to init; build must still succeed (reference behavior)."""
+    mlc = (NeuralNetConfiguration.builder().list()
+           .layer(DenseLayer(n_in=3, n_out=4))
+           .layer(OutputLayer(n_in=4, n_out=2))
+           .build())
+    assert mlc is not None
+
+
+def test_pad_sequences_promotes_dtype():
+    out, _ = pad_sequences([np.array([[1, 2]]), np.array([[0.5, 0.7]])])
+    assert out.dtype == np.float64
+    np.testing.assert_allclose(out[1, 0], [0.5, 0.7])
+
+
+def test_grad_norm_camelcase_accepted_snake_rejected_only_if_unknown():
+    mlc = (NeuralNetConfiguration.builder()
+           .gradient_normalization("RenormalizeL2PerLayer").list()
+           .layer(DenseLayer(n_out=4))
+           .layer(OutputLayer(n_out=2))
+           .set_input_type(inputs.feed_forward(3))
+           .build())
+    assert mlc is not None
+    with pytest.raises(ValueError, match="gradient_normalization"):
+        (NeuralNetConfiguration.builder()
+         .gradient_normalization("ClipToUnitBall").list()
+         .layer(DenseLayer(n_out=4))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(inputs.feed_forward(3))
+         .build())
+
+
+def test_tbptt_zero_forward_length_fails_at_build():
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    with pytest.raises(ValueError, match="tbptt_fwd_length"):
+        (NeuralNetConfiguration.builder().list()
+         .layer(GravesLSTM(n_in=3, n_out=4))
+         .layer(RnnOutputLayer(n_in=4, n_out=2))
+         .backprop_type("tbptt")
+         .t_bptt_forward_length(0)
+         .build())
+
+
+def test_graph_builder_validates_layers():
+    gb = (NeuralNetConfiguration.builder().graph_builder()
+          .add_inputs("in")
+          .add_layer("d", DenseLayer(n_in=4, n_out=0), "in")
+          .add_layer("out", OutputLayer(n_in=4, n_out=2), "d")
+          .set_outputs("out")
+          .set_input_types(inputs.feed_forward(4)))
+    with pytest.raises(ValueError, match="n_out must be positive"):
+        gb.build()
+
+
+def test_validate_good_config_builds():
+    mlc = (NeuralNetConfiguration.builder()
+           .updater("adam").learning_rate(0.01).list()
+           .layer(DenseLayer(n_out=8, dropout=0.2))
+           .layer(OutputLayer(n_out=3))
+           .set_input_type(inputs.feed_forward(4))
+           .build())
+    assert mlc.layers[0].n_in == 4
