@@ -105,7 +105,8 @@ def bench_lenet(batch: int = 256, steps: int = 50, trials: int = 3,
     }
 
 
-def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3) -> dict:
+def bench_resnet50(batch: int = 128, steps: int = 20,
+                   trials: int = 3) -> dict:
     """ResNet-50 synthetic-ImageNet training step (BASELINE config #2) —
     the real MXU test: conv-dominated, bf16 on TPU.  Batch 128 is the
     measured single-chip throughput optimum (32→1269, 64→1817,
@@ -204,7 +205,7 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
             "vs_baseline": None, "batch": batch, "seq": seq}
 
 
-def bench_vgg16(batch: int = 256, steps: int = 6, trials: int = 3) -> dict:
+def bench_vgg16(batch: int = 256, steps: int = 16, trials: int = 3) -> dict:
     """VGG-16 training step (BASELINE config #5: the Keras-import
     architecture — built through keras/trained_models.vgg16, the same
     config the importer targets), single chip; the 16-chip data-parallel

@@ -1,0 +1,80 @@
+"""ParallelWrapper CLI entry point.
+
+TPU-native equivalent of the reference's
+``parallelism/main/ParallelWrapperMain.java`` (JCommander flags at
+``:28-70``): load a serialized model, build a ParallelWrapper from CLI
+flags, fit it from a dataset-iterator factory, optionally save the
+result and feed a remote stats UI.
+
+Run: ``python -m deeplearning4j_tpu.parallel.main --model-path m.zip
+--iterator-factory mypkg.data:make_iterator --workers 8``
+
+The iterator factory is ``module:callable`` returning a DataSetIterator
+(the ``--dataSetIteratorFactoryClazz`` role)."""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+from typing import Optional, Sequence
+
+
+def _resolve_factory(spec: str):
+    module, sep, attr = spec.partition(":")
+    if not sep:
+        raise ValueError(
+            f"iterator factory must be 'module:callable', got {spec!r}")
+    return getattr(importlib.import_module(module), attr)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.parallel.main",
+        description="Data-parallel training driver (ParallelWrapperMain)")
+    p.add_argument("--model-path", required=True,
+                   help="serialized model zip (ModelSerializer format)")
+    p.add_argument("--iterator-factory", required=True,
+                   help="module:callable returning a DataSetIterator")
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker replicas (default: all devices)")
+    p.add_argument("--averaging-frequency", type=int, default=1)
+    p.add_argument("--average-updaters", action="store_true", default=True)
+    p.add_argument("--no-average-updaters", dest="average_updaters",
+                   action="store_false")
+    p.add_argument("--prefetch-size", type=int, default=2)
+    p.add_argument("--report-score", action="store_true")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--model-output-path", default=None,
+                   help="save the trained model here")
+    p.add_argument("--ui-url", default=None,
+                   help="remote UIServer base url to stream stats to")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    from ..utils import model_serializer
+    from ..utils.model_guesser import load_model_guess
+    from .parallel_wrapper import ParallelWrapper
+
+    args = build_parser().parse_args(argv)
+    net = load_model_guess(args.model_path)
+    iterator = _resolve_factory(args.iterator_factory)()
+
+    pw = ParallelWrapper(net, workers=args.workers,
+                         averaging_frequency=args.averaging_frequency,
+                         average_updaters=args.average_updaters,
+                         report_score=args.report_score,
+                         prefetch_size=args.prefetch_size)
+    if args.ui_url:
+        from ..ui import StatsListener
+        from ..ui.server import RemoteStatsStorageRouter
+        pw.set_listeners(StatsListener(RemoteStatsStorageRouter(
+            args.ui_url)))
+    pw.fit(iterator, epochs=args.epochs)
+    if args.model_output_path:
+        model_serializer.write_model(net, args.model_output_path)
+    return net
+
+
+if __name__ == "__main__":
+    main()

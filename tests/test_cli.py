@@ -1,0 +1,81 @@
+"""CLI entry-point tests (reference ParallelWrapperMain flags +
+PlayUIServer --uiPort)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import main as pw_main
+from deeplearning4j_tpu.ui import main as ui_main
+from deeplearning4j_tpu.utils import model_serializer
+
+
+def iterator_factory():
+    """Referenced by the CLI as test_cli:iterator_factory (the
+    --dataSetIteratorFactoryClazz role)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(X[:, 0] > 0).astype(int)
+                                    + (X[:, 1] > 0).astype(int)]
+    return ListDataSetIterator(DataSet(X, y), batch_size=8)
+
+
+def _write_model(path):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).updater("sgd").learning_rate(0.2)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    model_serializer.write_model(net, path)
+    return net
+
+
+def test_parallel_main_trains_and_saves(tmp_path):
+    model_in = str(tmp_path / "in.zip")
+    model_out = str(tmp_path / "out.zip")
+    _write_model(model_in)
+    net = pw_main.main([
+        "--model-path", model_in,
+        "--iterator-factory", "test_cli:iterator_factory",
+        "--workers", "2",
+        "--averaging-frequency", "2",
+        "--epochs", "2",
+        "--report-score",
+        "--model-output-path", model_out,
+    ])
+    assert net.iteration > 0
+    restored = model_serializer.restore_multi_layer_network(model_out)
+    np.testing.assert_allclose(restored.get_flat_params(),
+                               net.get_flat_params())
+
+
+def test_parallel_main_bad_factory_spec(tmp_path):
+    model_in = str(tmp_path / "m.zip")
+    _write_model(model_in)
+    with pytest.raises(ValueError, match="module:callable"):
+        pw_main.main(["--model-path", model_in,
+                      "--iterator-factory", "no_colon_here"])
+
+
+def test_ui_main_serves(capsys):
+    server = ui_main.serve(["--port", "0"], block=False)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        sessions = json.loads(
+            urllib.request.urlopen(base + "/train/sessions").read())
+        assert sessions == []
+        assert "listening" in capsys.readouterr().out
+    finally:
+        server.stop()
