@@ -274,10 +274,17 @@ class SequenceParallel:
 
     def attention(self, q: Array, k: Array, v: Array, *,
                   causal: bool = False, impl: str = "ring") -> Array:
-        """Full-shape (batch, T, heads, d) in and out; T % n_shards == 0."""
+        """Full-shape (batch, T, heads, d) in and out; T % n_shards == 0.
+
+        ``impl``: ``"ring"`` / ``"ulysses"`` shard the sequence over the
+        mesh; ``"flash"`` runs the single-device Pallas flash kernel
+        (``ops/attention.py``) — linear memory in T, no mesh required."""
+        if impl == "flash":
+            from ..ops.attention import flash_attention
+            return flash_attention(q, k, v, causal=causal)
         if impl not in ("ring", "ulysses"):
-            raise ValueError(f"unknown impl {impl!r}; use 'ring' or "
-                             f"'ulysses'")
+            raise ValueError(f"unknown impl {impl!r}; use 'ring', "
+                             f"'ulysses', or 'flash'")
         if q.shape[1] % self.n:
             raise ValueError(
                 f"sequence length {q.shape[1]} not divisible by "

@@ -1,0 +1,110 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh;
+the compiled Mosaic path is validated on the real chip by the bench/
+verify runs — BASELINE.md notes T=8192+ works where XLA full attention
+fails to compile)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.attention import flash_attention
+from deeplearning4j_tpu.parallel.sequence import (SequenceParallel,
+                                                  _full_attention)
+
+
+def _qkv(b=1, t=64, h=2, d=16, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, t, h, d).astype(dtype))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_oracle(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ragged_length_and_uneven_blocks():
+    """T not a multiple of the block size exercises the padding mask."""
+    q, k, v = _qkv(t=50)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_non_lane_width_head_dim():
+    """d not a multiple of 128 exercises the lane padding."""
+    q, k, v = _qkv(t=32, d=24)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    ref = _full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16_inputs():
+    q, k, v = _qkv(t=32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, causal=True, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.1, atol=0.1)
+
+
+def test_flash_gradients_match_oracle():
+    q, k, v = _qkv(t=32, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_full_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_mismatched_block_sizes():
+    """block_q/block_k that don't divide each other exercise the lcm
+    padding (a max-based pad silently drops trailing blocks)."""
+    q, k, v = _qkv(t=128, d=16)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=48)
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_non_tile_aligned_t_defaults():
+    """T=100 with default 128 blocks: the clamp must round the block to a
+    sublane multiple, not to T itself."""
+    q, k, v = _qkv(t=100, d=16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_rejects_bad_shapes():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="shapes differ"):
+        flash_attention(q, k[:, :32], v)
+    with pytest.raises(ValueError, match="batch, T, heads, d"):
+        flash_attention(q[0], k[0], v[0])
+
+
+def test_sequence_parallel_flash_impl():
+    q, k, v = _qkv(t=48)
+    sp = SequenceParallel(devices=jax.devices()[:8])
+    out = sp.attention(q, k, v, causal=True, impl="flash")
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
