@@ -14,8 +14,12 @@ accumulating into VMEM scratch (running max / denominator / weighted
 sum — the same log-sum-exp stream ``parallel/sequence.ring_attention``
 runs ACROSS chips; this kernel is the within-chip tier of the same
 algorithm).  f32 accumulation regardless of input dtype; causal masking
-by global block position; off-TPU (tests, CPU mesh) runs in Pallas
-interpret mode.
+by block position; off-TPU (tests, CPU mesh) runs in Pallas interpret
+mode.
+
+:func:`flash_attention_partial` — the same kernel emitting UNNORMALIZED
+(acc, m, l) partials so callers can fold in blocks computed elsewhere;
+``parallel/sequence.ring_flash_attention`` builds on it.
 
 Backward: a ``jax.custom_vjp`` recomputes gradients through the pure-XLA
 reference formulation (`parallel/sequence._full_attention`) — exact
@@ -26,6 +30,7 @@ remaining optimization headroom.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -39,59 +44,77 @@ Array = jax.Array
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  sm_scale: float, causal: bool, block_q: int,
-                  block_k: int, seq_len: int, num_k_blocks: int,
-                  precision):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+def _make_flash_kernel(*, emit_partials: bool, sm_scale: float,
+                       causal: bool, block_q: int, block_k: int,
+                       k_len: int, num_k_blocks: int, precision):
+    """ONE streaming-softmax kernel body for both the normalized and the
+    partial-emitting variants — only the finalize step differs, so the
+    numerically delicate core cannot drift between them."""
 
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr[:], _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr[:])
-        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+    def kernel(q_ref, k_ref, v_ref, *refs):
+        if emit_partials:
+            o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+        else:
+            (o_ref, m_scr, l_scr, acc_scr), m_ref, l_ref = refs, None, None
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
 
-    # Causal: a k block strictly above this q block's diagonal contributes
-    # nothing — skip its compute entirely (halves causal FLOPs).
-    needed = (ki * block_k <= qi * block_q + block_q - 1) \
-        if causal else (ki >= 0)
+        @pl.when(ki == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr[:], _NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr[:])
+            acc_scr[:] = jnp.zeros_like(acc_scr[:])
 
-    @pl.when(needed)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)           # (block_k, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=precision) * sm_scale
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(k_pos < seq_len, s, _NEG_INF)    # T padding
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        # Causal: a k block strictly above this q block's diagonal
+        # contributes nothing — skip its compute (halves causal FLOPs).
+        needed = (ki * block_k <= qi * block_q + block_q - 1) \
+            if causal else (ki >= 0)
 
-        m_prev = m_scr[:, :1]                      # (block_q, 1)
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alive = m_new > _NEG_INF / 2
-        p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
-        correction = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=precision)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        @pl.when(needed)
+        def _compute():
+            q = q_ref[0].astype(jnp.float32)       # (block_q, d)
+            k = k_ref[0].astype(jnp.float32)       # (block_k, d)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision) * sm_scale
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos < k_len, s, _NEG_INF)   # T padding
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-    @pl.when(ki == num_k_blocks - 1)
-    def _finalize():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+            m_prev = m_scr[:, :1]                  # (block_q, 1)
+            l_prev = l_scr[:, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            alive = m_new > _NEG_INF / 2
+            p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+            correction = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
+            l_new = l_prev * correction + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+            acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+                p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+            l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+        @pl.when(ki == num_k_blocks - 1)
+        def _finalize():
+            if emit_partials:
+                o_ref[0] = acc_scr[:]
+                m_ref[0] = m_scr[:]
+                l_ref[0] = l_scr[:]
+            else:
+                denom = jnp.maximum(l_scr[:, :1], 1e-30)
+                o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+    return kernel
 
 
+# ----------------------------------------------------------- shared plumbing
 def _pad_to(x: Array, axis: int, multiple: int) -> Array:
     size = x.shape[axis]
     pad = (-size) % multiple
@@ -102,32 +125,67 @@ def _pad_to(x: Array, axis: int, multiple: int) -> Array:
     return jnp.pad(x, widths)
 
 
+def _sds(shape, dtype, like: Array) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying ``like``'s shard_map varying-axes tag
+    (required for pallas_call under shard_map with vma checking)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _clamp_block(block: int, t: int) -> int:
+    """Clamp to the sequence, rounded UP to the f32 sublane tile (8):
+    Mosaic cannot tile a (1, block, d) BlockSpec whose sublane dim isn't
+    a multiple of 8; padding covers block > t."""
+    return -(-min(block, max(8, t)) // 8) * 8
+
+
+def _to_bhd(x: Array, block: int) -> Array:
+    """(B, T, H, D) -> (B*H, T_padded, D_padded): T padded to the block
+    multiple, D to the 128 lane width (zero padding is inert in q.k^T
+    and p@v)."""
+    B, T, H, D = x.shape
+    x = jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, D)
+    return _pad_to(_pad_to(x, 1, block), 2, 128)
+
+
+def _validate_qkv(q: Array, k: Array, v: Array,
+                  same_t: bool) -> None:
+    if q.ndim != 4:
+        raise ValueError(f"expected (batch, T, heads, d), got {q.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    if same_t and q.shape != k.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    if (q.shape[0], q.shape[2], q.shape[3]) != \
+            (k.shape[0], k.shape[2], k.shape[3]):
+        raise ValueError(
+            f"q and k/v disagree on batch/heads/d: {q.shape} vs {k.shape}")
+
+
+# ----------------------------------------------------------------- forward
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
                    sm_scale: float, block_q: int, block_k: int,
                    interpret: bool, precision) -> Array:
     B, T, H, D = q.shape
     bh = B * H
-
-    import math
-
-    def to_bhd(x):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, T, D)
-        # lcm, not max: both block sizes must divide the padded T or
-        # floor-divided block counts silently drop trailing blocks
-        x = _pad_to(x, 1, math.lcm(block_q, block_k))
-        return _pad_to(x, 2, 128)      # lane-width padding; zeros are
-        #                                inert in q.k^T and p@v
-
-    qt, kt, vt = to_bhd(q), to_bhd(k), to_bhd(v)
+    # lcm, not max: both block sizes must divide the padded T or
+    # floor-divided block counts silently drop trailing blocks
+    pad_mult = math.lcm(block_q, block_k)
+    qt = _to_bhd(q, pad_mult)
+    kt, vt = _to_bhd(k, pad_mult), _to_bhd(v, pad_mult)
     Tp, Dp = qt.shape[1], qt.shape[2]
     nq, nk = Tp // block_q, Tp // block_k
 
-    kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=T, num_k_blocks=nk, precision=precision)
+    kernel = _make_flash_kernel(
+        emit_partials=False, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, k_len=T, num_k_blocks=nk,
+        precision=precision)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, Tp, Dp), q.dtype),
+        out_shape=_sds((bh, Tp, Dp), q.dtype, qt),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
@@ -147,6 +205,80 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
+def flash_attention_partial(q: Array, k: Array, v: Array, *,
+                            causal: bool = False,
+                            sm_scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128,
+                            interpret: Optional[bool] = None,
+                            precision=None):
+    """Unnormalized blockwise attention of ``q`` against ONE K/V segment
+    (``k``/``v`` may have a different T than ``q``).
+
+    Returns ``(acc, m, l)`` with ``acc`` (batch, Tq, heads, d) f32 —
+    the exp-weighted value sum — and ``m``/``l`` (batch, Tq, heads) f32
+    running max / denominator.  Partials from different K/V segments
+    (e.g. ring-rotated shards) merge exactly via the log-sum-exp
+    combination (see ``parallel/sequence.ring_flash_attention``); the
+    final output is ``acc / l``.  ``causal`` masks by LOCAL positions —
+    correct for the diagonal ring step where q and kv shards share their
+    global offset.  Padded q rows are trimmed post-hoc, not masked
+    in-kernel (their partials are garbage but never returned).  Not
+    differentiable; callers own the VJP.
+    """
+    _validate_qkv(q, k, v, same_t=False)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = (float(sm_scale) if sm_scale is not None
+             else 1.0 / float(np.sqrt(D)))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_q = _clamp_block(block_q, Tq)
+    block_k = _clamp_block(block_k, Tk)
+    bh = B * H
+
+    qt = _to_bhd(q, block_q)
+    kt, vt = _to_bhd(k, block_k), _to_bhd(v, block_k)
+    Tqp, Dp = qt.shape[1], qt.shape[2]
+    nq, nk = Tqp // block_q, kt.shape[1] // block_k
+
+    kernel = _make_flash_kernel(
+        emit_partials=True, sm_scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, k_len=Tk, num_k_blocks=nk,
+        precision=precision)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        out_shape=[
+            _sds((bh, Tqp, Dp), jnp.float32, qt),
+            _sds((bh, Tqp, 128), jnp.float32, qt),
+            _sds((bh, Tqp, 128), jnp.float32, qt),
+        ],
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, Dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    def back(x, d_keep):
+        x = x[:, :Tq, :d_keep].reshape(B, H, Tq, d_keep)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    return back(acc, D), back(m, 1)[..., 0], back(l, 1)[..., 0]
+
+
+# --------------------------------------------------------------- custom VJP
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                 precision):
@@ -187,20 +319,13 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     XLA's fast-f32 path (bf16 passes, ~1e-3 abs error at randn scale);
     ``jax.lax.Precision.HIGHEST`` gives ~1e-6 at 3x the MXU work.
     Differentiable via custom VJP (see module docstring)."""
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} "
-                         f"{v.shape}")
-    if q.ndim != 4:
-        raise ValueError(f"expected (batch, T, heads, d), got {q.shape}")
+    _validate_qkv(q, k, v, same_t=True)
     scale = (float(sm_scale) if sm_scale is not None
              else 1.0 / float(np.sqrt(q.shape[-1])))
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     T = q.shape[1]
-    # clamp to the sequence, rounded UP to the f32 sublane tile (8):
-    # Mosaic cannot tile a (1, block, d) BlockSpec whose sublane dim
-    # isn't a multiple of 8; padding covers block > T
-    block_q = -(-min(block_q, max(8, T)) // 8) * 8
-    block_k = -(-min(block_k, max(8, T)) // 8) * 8
+    block_q = _clamp_block(block_q, T)
+    block_k = _clamp_block(block_k, T)
     return _flash_core(q, k, v, causal, scale, block_q, block_k,
                        bool(interpret), precision)

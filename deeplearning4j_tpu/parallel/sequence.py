@@ -16,6 +16,10 @@ with a ``seq`` axis (helpers that set up the shard_map are provided):
   steps).  Communication overlaps compute; the softmax uses the streaming
   log-sum-exp accumulation so no (T, T) score matrix ever exists.  Peak
   memory per chip is O(T/n · T/n) scores + O(T/n) activations.
+- :func:`ring_flash_attention` — the same ring, but each step's local
+  block runs the Pallas flash kernel (``ops/attention.py``), removing
+  the remaining O(T/n · T/n) score block: per-chip memory is O(T/n · d)
+  — linear in sequence length across AND within chips.
 - :func:`ulysses_attention` — the all-to-all alternative: two
   ``lax.all_to_all`` collectives swap the sharded axis from time to heads,
   each chip then attends over the FULL sequence for its head subset.  Best
@@ -131,6 +135,114 @@ def _full_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqhk,bkhd->bqhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+# --------------------------------------------------------------- ring+flash
+def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
+                         causal: bool = False,
+                         sm_scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128) -> Array:
+    """Ring attention whose per-step LOCAL block runs the Pallas flash
+    kernel — linear memory in sequence length both ACROSS chips (KV
+    shards rotate, nothing gathers) and WITHIN each chip (score tiles
+    live in VMEM, never materialized to HBM).  The einsum-based
+    :func:`ring_attention` materializes a (batch, T/n, heads, T/n) score
+    block per step; this variant removes that last quadratic term, so
+    per-chip memory is O(T/n · d).
+
+    Causality per ring step has exactly three cases — resident block from
+    a PAST chip (fully visible), from THIS chip (locally causal: global
+    offsets coincide), or from a FUTURE chip (fully masked, skipped) —
+    so the kernel never needs global position plumbing.
+
+    Differentiable: custom VJP recomputes through the einsum ring
+    (exact gradients; fused backward remains headroom).
+    """
+    return _ring_flash_core(q, k, v, axis_name, causal, sm_scale,
+                            block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_core(q, k, v, axis_name, causal, sm_scale, block_q,
+                     block_k):
+    return _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
+                               block_q, block_k)
+
+
+def _ring_flash_forward(q, k, v, axis_name, causal, sm_scale, block_q,
+                        block_k):
+    from ..ops.attention import flash_attention_partial
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    kwargs = dict(sm_scale=sm_scale, block_q=block_q, block_k=block_k)
+
+    def merge(o1, m1, l1, o2, m2, l2):
+        """Exact log-sum-exp combination of two unnormalized partials."""
+        m = jnp.maximum(m1, m2)
+        a1 = jnp.where(m1 > _NEG_INF / 2, jnp.exp(m1 - m), 0.0)
+        a2 = jnp.where(m2 > _NEG_INF / 2, jnp.exp(m2 - m), 0.0)
+        return (o1 * a1[..., None] + o2 * a2[..., None],
+                m, l1 * a1 + l2 * a2)
+
+    def body(carry, r):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - r) % n
+
+        def visible(_):
+            return flash_attention_partial(q, k_blk, v_blk, causal=False,
+                                           **kwargs)
+
+        def diagonal(_):
+            return flash_attention_partial(q, k_blk, v_blk, causal=True,
+                                           **kwargs)
+
+        def masked(_):
+            # fresh constants are replication-tracked as unvarying; the
+            # kernel branches are varying — align the types for switch
+            return lax.pcast(
+                (jnp.zeros(q.shape, jnp.float32),
+                 jnp.full(q.shape[:3], _NEG_INF, jnp.float32),
+                 jnp.zeros(q.shape[:3], jnp.float32)),
+                axis_name, to="varying")
+
+        if causal:
+            case = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            po, pm, pl_ = lax.switch(case, [visible, diagonal, masked],
+                                     operand=None)
+        else:
+            po, pm, pl_ = visible(None)
+        o, m, l = merge(o, m, l, po, pm, pl_)
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name,
+                                    _ring_perm(n))
+        return (o, m, l, k_blk, v_blk), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    o0, m0, l0 = lax.pcast((o0, m0, l0), axis_name, to="varying")
+    (o, _, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q,
+                    block_k):
+    out = _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
+                              block_q, block_k)
+    return out, (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: ring_attention(q, k, v, axis_name=axis_name,
+                                       causal=causal, sm_scale=sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_flash_core.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 # ------------------------------------------------------------------ ulysses
@@ -272,6 +384,14 @@ class SequenceParallel:
                                   causal=causal), 3)
             for causal in (False, True)}
 
+    @functools.cached_property
+    def _ring_flash(self):
+        return {
+            causal: self._sharded(
+                functools.partial(ring_flash_attention,
+                                  axis_name=self.axis, causal=causal), 3)
+            for causal in (False, True)}
+
     def attention(self, q: Array, k: Array, v: Array, *,
                   causal: bool = False, impl: str = "ring") -> Array:
         """Full-shape (batch, T, heads, d) in and out; T % n_shards == 0.
@@ -282,12 +402,13 @@ class SequenceParallel:
         if impl == "flash":
             from ..ops.attention import flash_attention
             return flash_attention(q, k, v, causal=causal)
-        if impl not in ("ring", "ulysses"):
+        if impl not in ("ring", "ulysses", "ring_flash"):
             raise ValueError(f"unknown impl {impl!r}; use 'ring', "
-                             f"'ulysses', or 'flash'")
+                             f"'ulysses', 'ring_flash', or 'flash'")
         if q.shape[1] % self.n:
             raise ValueError(
                 f"sequence length {q.shape[1]} not divisible by "
                 f"{self.n} seq shards")
-        table = self._ring if impl == "ring" else self._ulysses
+        table = {"ring": self._ring, "ulysses": self._ulysses,
+                 "ring_flash": self._ring_flash}[impl]
         return table[causal](q, k, v)

@@ -108,3 +108,71 @@ def test_sequence_parallel_flash_impl():
     ref = _full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_partial_merges_to_full():
+    """Partials over two KV halves merged via log-sum-exp equal full
+    attention — the invariant ring_flash_attention is built on."""
+    from deeplearning4j_tpu.ops.attention import flash_attention_partial
+    q, k, v = _qkv(t=32, d=16)
+    half = 16
+    o1, m1, l1 = flash_attention_partial(q, k[:, :half], v[:, :half],
+                                         block_q=16, block_k=16)
+    o2, m2, l2 = flash_attention_partial(q, k[:, half:], v[:, half:],
+                                         block_q=16, block_k=16)
+    m = np.maximum(np.asarray(m1), np.asarray(m2))
+    a1 = np.exp(np.asarray(m1) - m)
+    a2 = np.exp(np.asarray(m2) - m)
+    o = np.asarray(o1) * a1[..., None] + np.asarray(o2) * a2[..., None]
+    l = np.asarray(l1) * a1 + np.asarray(l2) * a2
+    ref = _full_attention(q, k, v)
+    np.testing.assert_allclose(o / l[..., None], np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_attention_matches_full(causal):
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.sequence import ring_flash_attention
+    q, k, v = _qkv(t=32, h=2, d=16)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
+    fn = jax.jit(jax.shard_map(
+        functools.partial(ring_flash_attention, axis_name="seq",
+                          causal=causal, block_q=8, block_k=8),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq")))
+    out = fn(q, k, v)
+    ref = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_gradients_match_full():
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.sequence import ring_flash_attention
+    q, k, v = _qkv(t=16, h=2, d=8)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
+    rf = jax.shard_map(
+        functools.partial(ring_flash_attention, axis_name="seq",
+                          causal=True, block_q=8, block_k=8),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"))
+    gf = jax.jit(jax.grad(lambda q, k, v: jnp.sum(rf(q, k, v) ** 2),
+                          argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        _full_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_parallel_ring_flash_impl():
+    q, k, v = _qkv(t=64)
+    sp = SequenceParallel(devices=jax.devices()[:8])
+    out = sp.attention(q, k, v, causal=True, impl="ring_flash")
+    ref = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
