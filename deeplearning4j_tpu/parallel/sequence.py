@@ -141,7 +141,9 @@ def _full_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
 def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
                          causal: bool = False,
                          sm_scale: Optional[float] = None,
-                         block_q: int = 128, block_k: int = 128) -> Array:
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: Optional[bool] = None,
+                         precision=None) -> Array:
     """Ring attention whose per-step LOCAL block runs the Pallas flash
     kernel — linear memory in sequence length both ACROSS chips (KV
     shards rotate, nothing gathers) and WITHIN each chip (score tiles
@@ -157,25 +159,30 @@ def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
 
     Differentiable: custom VJP recomputes through the einsum ring
     (exact gradients; fused backward remains headroom).
+
+    ``interpret``/``precision`` thread through to the kernel —
+    pass ``interpret=True`` when the mesh devices aren't the default
+    backend (e.g. a CPU mesh on a TPU-attached host).
     """
     return _ring_flash_core(q, k, v, axis_name, causal, sm_scale,
-                            block_q, block_k)
+                            block_q, block_k, interpret, precision)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash_core(q, k, v, axis_name, causal, sm_scale, block_q,
-                     block_k):
+                     block_k, interpret, precision):
     return _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
-                               block_q, block_k)
+                               block_q, block_k, interpret, precision)
 
 
 def _ring_flash_forward(q, k, v, axis_name, causal, sm_scale, block_q,
-                        block_k):
+                        block_k, interpret, precision):
     from ..ops.attention import flash_attention_partial
 
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
-    kwargs = dict(sm_scale=sm_scale, block_q=block_q, block_k=block_k)
+    kwargs = dict(sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                  interpret=interpret, precision=precision)
 
     def merge(o1, m1, l1, o2, m2, l2):
         """Exact log-sum-exp combination of two unnormalized partials."""
@@ -227,13 +234,14 @@ def _ring_flash_forward(q, k, v, axis_name, causal, sm_scale, block_q,
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q,
-                    block_k):
+                    block_k, interpret, precision):
     out = _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
-                              block_q, block_k)
+                              block_q, block_k, interpret, precision)
     return out, (q, k, v)
 
 
-def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k, res, g):
+def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k,
+                    interpret, precision, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q, k, v: ring_attention(q, k, v, axis_name=axis_name,
@@ -386,10 +394,14 @@ class SequenceParallel:
 
     @functools.cached_property
     def _ring_flash(self):
+        # derive interpret from the MESH devices, not the default backend:
+        # a CPU mesh on a TPU-attached host must not lower Mosaic for CPU
+        interpret = all(d.platform != "tpu" for d in self.mesh.devices.flat)
         return {
             causal: self._sharded(
                 functools.partial(ring_flash_attention,
-                                  axis_name=self.axis, causal=causal), 3)
+                                  axis_name=self.axis, causal=causal,
+                                  interpret=interpret), 3)
             for causal in (False, True)}
 
     def attention(self, q: Array, k: Array, v: Array, *,
