@@ -64,15 +64,19 @@ def cross_host_mean(flat: np.ndarray, weight: float = 1.0) -> np.ndarray:
 
 
 def run_multi_host_training(net, training_master, all_paths: Sequence[str],
-                            epochs: int = 1) -> None:
+                            epochs: int = 1) -> List[str]:
     """The full multi-host loop: every host trains its shard with the local
     master, then params are cross-host averaged after every epoch.  (Reference
     analogue: executors fit partitions, driver averages per split — here the
     per-split averaging is local to each host's workers and the cross-host
     average is per epoch to keep DCN traffic off the inner loop, the
-    standard TPU-pod local-SGD layering.)"""
+    standard TPU-pod local-SGD layering.)
+
+    Returns this host's shard (the paths actually trained), so callers can
+    report/weight without re-deriving the sharding."""
     shard = host_shard(all_paths)
     for _ in range(epochs):
         training_master.execute_training_paths(net, shard)
         net.set_flat_params(cross_host_mean(
             net.get_flat_params(), weight=float(len(shard) or 1)))
+    return shard
