@@ -162,9 +162,13 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
                                                         RnnOutputLayer)
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(12).updater("rmsprop").learning_rate(0.1)
-            .weight_init("xavier")
+    builder = (NeuralNetConfiguration.builder()
+               .seed(12).updater("rmsprop").learning_rate(0.1)
+               .weight_init("xavier"))
+    bf16 = _bf16_if_tpu()
+    if bf16:
+        builder = builder.compute_dtype(bf16)
+    conf = (builder
             .list()
             .layer(GravesLSTM(n_in=vocab, n_out=hidden, activation="tanh"))
             .layer(GravesLSTM(n_in=hidden, n_out=hidden, activation="tanh"))
