@@ -1,0 +1,153 @@
+"""Time sources for cross-host event ordering.
+
+TPU-native equivalent of the reference's ``spark/time`` package
+(``TimeSource.java`` SPI, ``SystemClockTimeSource``, ``NTPTimeSource`` —
+an NTP-disciplined clock so training events from different hosts order
+correctly, selected via ``TimeSourceProvider``).
+
+- :class:`SystemClockTimeSource` — wall clock.
+- :class:`NtpTimeSource` — SNTP (RFC 4330) client over stdlib UDP:
+  queries the server every ``update_frequency`` seconds, keeps the last
+  measured offset, and applies it to the wall clock.  Query failures
+  keep the previous offset (the reference behaves the same); the
+  default public pool is unreachable in zero-egress environments, so
+  construction takes any ``server`` (tests run a loopback mock).
+- :func:`get_time_source` — ``TimeSourceProvider`` role: selects the
+  implementation from the ``DL4J_TPU_TIMESOURCE`` env var
+  (``system`` | ``ntp``, default system).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+# Seconds between the NTP epoch (1900) and the Unix epoch (1970).
+_NTP_DELTA = 2208988800
+
+
+class TimeSource:
+    """Reference ``TimeSource.java``: milliseconds since the Unix epoch."""
+
+    def current_time_millis(self) -> int:
+        raise NotImplementedError
+
+
+class SystemClockTimeSource(TimeSource):
+    """Reference ``SystemClockTimeSource``."""
+
+    def current_time_millis(self) -> int:
+        return int(time.time() * 1000)
+
+
+def sntp_query(server: str, port: int = 123,
+               timeout: float = 5.0) -> float:
+    """One SNTP exchange; returns the clock offset in seconds
+    (positive = local clock is behind the server).
+
+    RFC 4330 offset: ((T2 - T1) + (T3 - T4)) / 2 with T1/T4 local
+    send/receive and T2/T3 server receive/transmit timestamps.
+    Standard SNTP client defenses applied: the socket is connect()ed so
+    only the queried server's address is accepted, the response's
+    originate timestamp must echo our transmit T1, and replies that are
+    not server-mode, carry an invalid stratum (0 / Kiss-o'-Death /
+    >15), or a zero transmit timestamp are rejected."""
+    packet = bytearray(48)
+    packet[0] = (0 << 6) | (4 << 3) | 3      # LI=0, VN=4, mode=3 (client)
+    t1 = time.time()
+    t1_secs = int(t1 + _NTP_DELTA)
+    t1_frac = int((t1 + _NTP_DELTA - t1_secs) * 2 ** 32)
+    struct.pack_into(">II", packet, 40, t1_secs, t1_frac)
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.connect((server, port))            # reject off-path datagrams
+        s.send(bytes(packet))
+        data = s.recv(512)
+    t4 = time.time()
+    if len(data) < 48:
+        raise ValueError(f"short NTP response ({len(data)} bytes)")
+    mode = data[0] & 0x07
+    if mode not in (4, 5):                   # server / broadcast
+        raise ValueError(f"not a server reply (mode {mode})")
+    stratum = data[1]
+    if not 1 <= stratum <= 15:               # 0 = KoD/unsynchronized
+        raise ValueError(f"invalid stratum {stratum}")
+    if data[24:32] != bytes(packet[40:48]):
+        raise ValueError("originate timestamp mismatch (stale or forged "
+                         "reply)")
+
+    def ts(offset: int) -> float:
+        secs, frac = struct.unpack_from(">II", data, offset)
+        return secs - _NTP_DELTA + frac / 2 ** 32
+
+    if struct.unpack_from(">II", data, 40) == (0, 0):
+        raise ValueError("zero transmit timestamp")
+    t2 = ts(32)                              # receive timestamp
+    t3 = ts(40)                              # transmit timestamp
+    return ((t2 - t1) + (t3 - t4)) / 2.0
+
+
+class NtpTimeSource(TimeSource):
+    """Reference ``NTPTimeSource``: wall clock corrected by the last
+    measured NTP offset, refreshed on a daemon thread every
+    ``update_frequency`` seconds."""
+
+    def __init__(self, server: str = "pool.ntp.org", port: int = 123,
+                 update_frequency: float = 1800.0, timeout: float = 5.0,
+                 auto_update: bool = True):
+        self.server = server
+        self.port = port
+        self.update_frequency = update_frequency
+        self.timeout = timeout
+        self._offset = 0.0
+        self._last_update: Optional[float] = None
+        self.last_error: Optional[Exception] = None
+        self._stop = threading.Event()
+        # First sync runs on the daemon thread (or on an explicit
+        # update() call), NOT in the constructor: DNS resolution is not
+        # bounded by socket timeouts, and a blackholed resolver must not
+        # hang startup.
+        if auto_update:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        self.update()                        # eager first sync, off-thread
+        while not self._stop.wait(self.update_frequency):
+            self.update()
+
+    def update(self) -> bool:
+        """One sync attempt; on failure the previous offset stands."""
+        try:
+            self._offset = sntp_query(self.server, self.port, self.timeout)
+            self._last_update = time.time()
+            self.last_error = None
+            return True
+        except Exception as e:
+            self.last_error = e
+            return False
+
+    @property
+    def offset_seconds(self) -> float:
+        return self._offset
+
+    def current_time_millis(self) -> int:
+        return int((time.time() + self._offset) * 1000)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def get_time_source() -> TimeSource:
+    """Reference ``TimeSourceProvider``: env-selected implementation."""
+    kind = os.environ.get("DL4J_TPU_TIMESOURCE", "system").lower()
+    if kind == "ntp":
+        return NtpTimeSource(
+            server=os.environ.get("DL4J_TPU_NTP_SERVER", "pool.ntp.org"))
+    if kind == "system":
+        return SystemClockTimeSource()
+    raise ValueError(f"unknown DL4J_TPU_TIMESOURCE {kind!r}")

@@ -1,0 +1,147 @@
+"""Time-source tests (reference spark/time: TimeSource SPI, NTP
+discipline) against a loopback mock SNTP server."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.utils.time_source import (NtpTimeSource,
+                                                  SystemClockTimeSource,
+                                                  get_time_source,
+                                                  sntp_query, _NTP_DELTA)
+
+
+class _MockNtpServer:
+    """Loopback SNTP server answering with a fixed clock offset."""
+
+    def __init__(self, offset_seconds: float):
+        self.offset = offset_seconds
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self.requests = 0
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    mode = 4
+    stratum = 2
+    echo_originate = True
+
+    def _serve(self):
+        while True:
+            try:
+                data, addr = self._sock.recvfrom(512)
+            except OSError:
+                return
+            self.requests += 1
+            resp = bytearray(48)
+            resp[0] = (0 << 6) | (4 << 3) | self.mode
+            resp[1] = self.stratum
+            if self.echo_originate:
+                resp[24:32] = data[40:48]               # originate = T1
+            now = time.time() + self.offset
+            secs = int(now + _NTP_DELTA)
+            frac = int((now + _NTP_DELTA - secs) * 2 ** 32)
+            struct.pack_into(">II", resp, 32, secs, frac)   # receive ts
+            struct.pack_into(">II", resp, 40, secs, frac)   # transmit ts
+            self._sock.sendto(bytes(resp), addr)
+
+    def close(self):
+        self._sock.close()
+
+
+def test_system_clock_source():
+    ts = SystemClockTimeSource()
+    assert abs(ts.current_time_millis() - time.time() * 1000) < 100
+
+
+@pytest.mark.parametrize("offset", [5.0, -3.0])
+def test_sntp_query_measures_offset(offset):
+    server = _MockNtpServer(offset)
+    try:
+        measured = sntp_query("127.0.0.1", server.port, timeout=2.0)
+        assert measured == pytest.approx(offset, abs=0.25)
+    finally:
+        server.close()
+
+
+def test_ntp_time_source_applies_offset():
+    server = _MockNtpServer(10.0)
+    try:
+        ts = NtpTimeSource("127.0.0.1", server.port, auto_update=False,
+                           timeout=2.0)
+        assert ts.update() is True
+        assert ts.last_error is None
+        assert ts.offset_seconds == pytest.approx(10.0, abs=0.25)
+        drift = ts.current_time_millis() - time.time() * 1000
+        assert drift == pytest.approx(10_000, abs=300)
+        ts.close()
+    finally:
+        server.close()
+
+
+def test_ntp_failure_keeps_previous_offset():
+    server = _MockNtpServer(2.0)
+    ts = NtpTimeSource("127.0.0.1", server.port, auto_update=False,
+                       timeout=0.5)
+    assert ts.update() is True
+    assert ts.offset_seconds == pytest.approx(2.0, abs=0.25)
+    server.close()                      # server gone; next update fails
+    assert ts.update() is False
+    assert ts.last_error is not None
+    assert ts.offset_seconds == pytest.approx(2.0, abs=0.25)   # retained
+    ts.close()
+
+
+def test_sntp_rejects_unsynchronized_and_kod_replies():
+    """Stratum-0 (Kiss-o'-Death / unsynchronized) replies must raise, not
+    wind the clock back ~70 years."""
+    server = _MockNtpServer(0.0)
+    server.stratum = 0
+    try:
+        with pytest.raises(ValueError, match="stratum"):
+            sntp_query("127.0.0.1", server.port, timeout=2.0)
+    finally:
+        server.close()
+
+
+def test_sntp_rejects_non_server_mode():
+    server = _MockNtpServer(0.0)
+    server.mode = 3                     # client mode echoed back
+    try:
+        with pytest.raises(ValueError, match="mode"):
+            sntp_query("127.0.0.1", server.port, timeout=2.0)
+    finally:
+        server.close()
+
+
+def test_sntp_rejects_originate_mismatch():
+    """A reply that doesn't echo our transmit timestamp (stale/forged)
+    must be rejected."""
+    server = _MockNtpServer(0.0)
+    server.echo_originate = False
+    try:
+        with pytest.raises(ValueError, match="originate"):
+            sntp_query("127.0.0.1", server.port, timeout=2.0)
+    finally:
+        server.close()
+
+
+def test_ntp_constructor_does_not_block(monkeypatch):
+    """Construction must not synchronously resolve/query (unbounded DNS
+    in zero-egress environments)."""
+    t0 = time.perf_counter()
+    ts = NtpTimeSource("192.0.2.1", 123, auto_update=False, timeout=5.0)
+    assert time.perf_counter() - t0 < 0.5
+    ts.close()
+
+
+def test_provider_selection(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_TIMESOURCE", raising=False)
+    assert isinstance(get_time_source(), SystemClockTimeSource)
+    monkeypatch.setenv("DL4J_TPU_TIMESOURCE", "bogus")
+    with pytest.raises(ValueError):
+        get_time_source()
