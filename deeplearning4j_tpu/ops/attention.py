@@ -21,10 +21,12 @@ mode.
 (acc, m, l) partials so callers can fold in blocks computed elsewhere;
 ``parallel/sequence.ring_flash_attention`` builds on it.
 
-Backward: a ``jax.custom_vjp`` recomputes gradients through the pure-XLA
-reference formulation (`parallel/sequence._full_attention`) — exact
-gradients at XLA-path memory cost; a fused backward kernel is the
-remaining optimization headroom.
+Backward: by default a FUSED two-pass Pallas backward (dK/dV then dQ)
+rebuilds P tiles in VMEM from the forward's saved per-row logsumexp —
+O(T·d) memory end to end, so full training steps run at T=16384 where
+the XLA attention path cannot even compile its forward.
+``fused_backward=False`` falls back to recomputing through the XLA
+formulation (`parallel/sequence._full_attention`).
 """
 
 from __future__ import annotations
@@ -44,16 +46,23 @@ Array = jax.Array
 _NEG_INF = -1e30
 
 
-def _make_flash_kernel(*, emit_partials: bool, sm_scale: float,
+def _make_flash_kernel(*, mode: str, sm_scale: float,
                        causal: bool, block_q: int, block_k: int,
                        k_len: int, num_k_blocks: int, precision):
-    """ONE streaming-softmax kernel body for both the normalized and the
-    partial-emitting variants — only the finalize step differs, so the
-    numerically delicate core cannot drift between them."""
+    """ONE streaming-softmax kernel body for all forward variants —
+    ``mode``: "normalized" (out), "partials" (unnormalized acc + m + l),
+    or "normalized_lse" (out + per-row logsumexp, the fused-backward
+    forward).  Only the finalize step differs, so the numerically
+    delicate core cannot drift between them."""
+    if mode not in ("normalized", "partials", "normalized_lse"):
+        raise ValueError(f"unknown kernel mode {mode!r}")
 
     def kernel(q_ref, k_ref, v_ref, *refs):
-        if emit_partials:
+        if mode == "partials":
             o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+        elif mode == "normalized_lse":
+            (o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+            m_ref = l_ref = None
         else:
             (o_ref, m_scr, l_scr, acc_scr), m_ref, l_ref = refs, None, None
         qi = pl.program_id(1)
@@ -103,13 +112,16 @@ def _make_flash_kernel(*, emit_partials: bool, sm_scale: float,
 
         @pl.when(ki == num_k_blocks - 1)
         def _finalize():
-            if emit_partials:
+            if mode == "partials":
                 o_ref[0] = acc_scr[:]
                 m_ref[0] = m_scr[:]
                 l_ref[0] = l_scr[:]
             else:
                 denom = jnp.maximum(l_scr[:, :1], 1e-30)
                 o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+                if mode == "normalized_lse":
+                    lse = m_scr[:, :1] + jnp.log(denom)
+                    lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
     return kernel
 
@@ -168,7 +180,8 @@ def _validate_qkv(q: Array, k: Array, v: Array,
 # ----------------------------------------------------------------- forward
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
                    sm_scale: float, block_q: int, block_k: int,
-                   interpret: bool, precision) -> Array:
+                   interpret: bool, precision,
+                   with_lse: bool = False):
     B, T, H, D = q.shape
     bh = B * H
     # lcm, not max: both block sizes must divide the padded T or
@@ -180,20 +193,26 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
     nq, nk = Tp // block_q, Tp // block_k
 
     kernel = _make_flash_kernel(
-        emit_partials=False, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, k_len=T, num_k_blocks=nk,
-        precision=precision)
-    out = pl.pallas_call(
+        mode="normalized_lse" if with_lse else "normalized",
+        sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, k_len=T, num_k_blocks=nk, precision=precision)
+    out_shapes = [_sds((bh, Tp, Dp), q.dtype, qt)]
+    out_specs = [pl.BlockSpec((1, block_q, Dp),
+                              lambda b, qi, ki: (b, qi, 0))]
+    if with_lse:
+        out_shapes.append(_sds((bh, Tp, 128), jnp.float32, qt))
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda b, qi, ki: (b, qi, 0)))
+    result = pl.pallas_call(
         kernel,
-        out_shape=_sds((bh, Tp, Dp), q.dtype, qt),
+        out_shape=out_shapes if with_lse else out_shapes[0],
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, Dp),
-                               lambda b, qi, ki: (b, qi, 0)),
+        out_specs=out_specs if with_lse else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
@@ -201,8 +220,15 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    out = out[:, :T, :D].reshape(B, H, T, D)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out = result[0] if with_lse else result
+
+    def back(x, d_keep):
+        x = x[:, :T, :d_keep].reshape(B, H, T, d_keep)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    if with_lse:
+        return back(out, D), back(result[1], 1)[..., 0]   # (B,T,H) lse
+    return back(out, D)
 
 
 def flash_attention_partial(q: Array, k: Array, v: Array, *,
@@ -242,7 +268,7 @@ def flash_attention_partial(q: Array, k: Array, v: Array, *,
     nq, nk = Tqp // block_q, kt.shape[1] // block_k
 
     kernel = _make_flash_kernel(
-        emit_partials=True, sm_scale=scale, causal=causal,
+        mode="partials", sm_scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, k_len=Tk, num_k_blocks=nk,
         precision=precision)
     acc, m, l = pl.pallas_call(
@@ -278,25 +304,228 @@ def flash_attention_partial(q: Array, k: Array, v: Array, *,
     return back(acc, D), back(m, 1)[..., 0], back(l, 1)[..., 0]
 
 
+# ------------------------------------------------------- fused backward
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, qi, ki, *,
+              sm_scale, causal, block_q, block_k, q_len, k_len,
+              precision):
+    """The shared P-rebuild tile math of BOTH backward kernels: returns
+    (q, k, do, p, ds) for one (q-block, k-block) tile.  One body so the
+    numerically delicate core cannot drift between dK/dV and dQ (the
+    same invariant the forward keeps via _make_flash_kernel)."""
+    q = q_ref[0].astype(jnp.float32)           # (block_q, d)
+    k = k_ref[0].astype(jnp.float32)           # (block_k, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision) * sm_scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = (k_pos < k_len) & (q_pos < q_len)
+    if causal:
+        mask &= q_pos >= k_pos
+    L = L_ref[0][:, :1]                        # (block_q, 1) logsumexp
+    p = jnp.where(mask, jnp.exp(s - L), 0.0)
+    do = do_ref[0].astype(jnp.float32)         # (block_q, d)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    D = D_ref[0][:, :1]
+    ds = p * (dp - D) * sm_scale
+    return q, k, do, p, ds
+
+
+def _make_dkdv_kernel(*, num_q_blocks: int, precision, **tile_kw):
+    """Grid (bh, k_blocks, q_blocks): accumulate dK/dV for one k-block
+    across all q-blocks, rebuilding P tiles from the saved logsumexp —
+    no (T, T) materialization."""
+    causal = tile_kw["causal"]
+    block_q, block_k = tile_kw["block_q"], tile_kw["block_k"]
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, dk_ref, dv_ref,
+               dk_scr, dv_scr):
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_scr[:] = jnp.zeros_like(dk_scr[:])
+            dv_scr[:] = jnp.zeros_like(dv_scr[:])
+
+        needed = (qi * block_q + block_q - 1 >= ki * block_k) \
+            if causal else (qi >= 0)
+
+        @pl.when(needed)
+        def _compute():
+            q, _, do, p, ds = _bwd_tile(
+                q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, qi, ki,
+                precision=precision, **tile_kw)
+            dv_scr[:] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+            dk_scr[:] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+
+        @pl.when(qi == num_q_blocks - 1)
+        def _finalize():
+            dk_ref[0] = dk_scr[:]
+            dv_ref[0] = dv_scr[:]
+
+    return kernel
+
+
+def _make_dq_kernel(*, num_k_blocks: int, precision, **tile_kw):
+    """Grid (bh, q_blocks, k_blocks): accumulate dQ for one q-block."""
+    causal = tile_kw["causal"]
+    block_q, block_k = tile_kw["block_q"], tile_kw["block_k"]
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, dq_ref, dq_scr):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_scr[:] = jnp.zeros_like(dq_scr[:])
+
+        needed = (ki * block_k <= qi * block_q + block_q - 1) \
+            if causal else (ki >= 0)
+
+        @pl.when(needed)
+        def _compute():
+            _, k, _, _, ds = _bwd_tile(
+                q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, qi, ki,
+                precision=precision, **tile_kw)
+            dq_scr[:] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+
+        @pl.when(ki == num_k_blocks - 1)
+        def _finalize():
+            dq_ref[0] = dq_scr[:]
+
+    return kernel
+
+
+def _row_stat_to_bhd(x: Array, block: int) -> Array:
+    """(B, T, H) per-row statistic -> (B*H, T_padded, 128) lane-broadcast
+    layout the backward kernels read as ``ref[0][:, :1]``."""
+    B, T, H = x.shape
+    x = jnp.transpose(x, (0, 2, 1)).reshape(B * H, T)
+    x = _pad_to(x, 1, block)
+    return jnp.broadcast_to(x[:, :, None], x.shape + (128,))
+
+
+def flash_attention_bwd(q: Array, k: Array, v: Array, out: Array,
+                        L: Array, g: Array, *, causal: bool,
+                        sm_scale: float, block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None,
+                        precision=None):
+    """Fused flash backward: (dq, dk, dv) from the forward residuals
+    ``out`` and the per-row logsumexp ``L = m + log(l)`` — two Pallas
+    passes (dK/dV then dQ), O(T·d) memory, no (T, T) tensors."""
+    B, T, H, D = q.shape
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_q = _clamp_block(block_q, T)
+    block_k = _clamp_block(block_k, T)
+    pad_mult = math.lcm(block_q, block_k)
+    bh = B * H
+
+    qt = _to_bhd(q, pad_mult)
+    kt, vt = _to_bhd(k, pad_mult), _to_bhd(v, pad_mult)
+    dot = _to_bhd(g.astype(jnp.float32), pad_mult)
+    Tp, Dp = qt.shape[1], qt.shape[2]
+    nq, nk = Tp // block_q, Tp // block_k
+
+    # D_i = rowsum(dO * O): cheap elementwise, stays in XLA
+    Drow = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                   axis=-1)                                   # (B, T, H)
+    Lt = _row_stat_to_bhd(L, pad_mult)
+    Dt = _row_stat_to_bhd(Drow, pad_mult)
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, q_len=T, k_len=T, precision=precision)
+    dk, dv = pl.pallas_call(
+        _make_dkdv_kernel(num_q_blocks=nq, **common),
+        out_shape=[_sds((bh, Tp, Dp), jnp.float32, qt)] * 2,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, Dp), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, Dp), jnp.float32),
+            pltpu.VMEM((block_k, Dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, Lt, Dt)
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(num_k_blocks=nk, **common),
+        out_shape=_sds((bh, Tp, Dp), jnp.float32, qt),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dp),
+                               lambda b, qi, ki: (b, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, Dp), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, Lt, Dt)
+
+    def back(x):
+        x = x[:, :T, :D].reshape(B, H, T, D)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    return (back(dq).astype(q.dtype), back(dk).astype(k.dtype),
+            back(dv).astype(v.dtype))
+
+
 # --------------------------------------------------------------- custom VJP
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-                precision):
+                precision, fused_backward):
     return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
                           interpret, precision)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-               precision):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret, precision)
-    return out, (q, k, v)
+               precision, fused_backward):
+    if not fused_backward:
+        out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                             interpret, precision)
+        return out, (q, k, v, None, None)
+    # normalized_lse mode: the kernel finalizes out in-VMEM and emits
+    # only the one per-row logsumexp residual the backward needs.
+    out, L = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret, precision, with_lse=True)
+    return out, (q, k, v, out, L)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, precision,
-               res, g):
+               fused_backward, res, g):
+    q, k, v, out, L = res
+    if fused_backward:
+        return flash_attention_bwd(
+            q, k, v, out, L, g, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            precision=precision)
     from ..parallel.sequence import _full_attention
-    q, k, v = res
     _, vjp = jax.vjp(
         lambda q, k, v: _full_attention(q, k, v, causal=causal,
                                         sm_scale=sm_scale), q, k, v)
@@ -310,7 +539,8 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
                     sm_scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128,
                     interpret: Optional[bool] = None,
-                    precision: Optional[jax.lax.Precision] = None) -> Array:
+                    precision: Optional[jax.lax.Precision] = None,
+                    fused_backward: bool = True) -> Array:
     """Flash attention over (batch, T, heads, d_head) q/k/v.
 
     ``interpret=None`` auto-selects: compiled Mosaic on TPU, Pallas
@@ -318,7 +548,10 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     ``precision``: MXU precision for the two dots — default matches
     XLA's fast-f32 path (bf16 passes, ~1e-3 abs error at randn scale);
     ``jax.lax.Precision.HIGHEST`` gives ~1e-6 at 3x the MXU work.
-    Differentiable via custom VJP (see module docstring)."""
+    ``fused_backward=True`` (default) differentiates through two fused
+    Pallas passes (dK/dV then dQ) rebuilding P tiles from the saved
+    logsumexp — O(T·d) backward memory; ``False`` falls back to
+    recomputing through the XLA formulation (O(T²) scores under grad)."""
     _validate_qkv(q, k, v, same_t=True)
     scale = (float(sm_scale) if sm_scale is not None
              else 1.0 / float(np.sqrt(q.shape[-1])))
@@ -328,4 +561,4 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     block_q = _clamp_block(block_q, T)
     block_k = _clamp_block(block_k, T)
     return _flash_core(q, k, v, causal, scale, block_q, block_k,
-                       bool(interpret), precision)
+                       bool(interpret), precision, bool(fused_backward))

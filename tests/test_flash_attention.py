@@ -56,18 +56,40 @@ def test_flash_bf16_inputs():
                                np.asarray(ref), rtol=0.1, atol=0.1)
 
 
-def test_flash_gradients_match_oracle():
+@pytest.mark.parametrize("fused", [True, False])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_oracle(fused, causal):
+    """Both backward paths — the fused two-pass Pallas kernels and the
+    XLA-recompute fallback — must match the oracle."""
     q, k, v = _qkv(t=32, d=8)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
-                                       block_k=16) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=16,
+                                       block_k=16,
+                                       fused_backward=fused) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(_full_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(_full_attention(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_backward_ragged_and_uneven_blocks(causal):
+    """T not divisible by blocks + mismatched block sizes exercise the
+    backward kernels' padding masks and lcm padding — in BOTH causal and
+    non-causal modes (the k_pos/q_pos padding terms differ)."""
+    q, k, v = _qkv(t=50, d=16)
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=causal, block_q=16,
+                        block_k=32) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        _full_attention(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
