@@ -421,35 +421,48 @@ def flash_attention_bwd(q: Array, k: Array, v: Array, out: Array,
                         sm_scale: float, block_q: int = 128,
                         block_k: int = 128,
                         interpret: Optional[bool] = None,
-                        precision=None):
+                        precision=None, D_row: Optional[Array] = None):
     """Fused flash backward: (dq, dk, dv) from the forward residuals
     ``out`` and the per-row logsumexp ``L = m + log(l)`` — two Pallas
-    passes (dK/dV then dQ), O(T·d) memory, no (T, T) tensors."""
-    B, T, H, D = q.shape
+    passes (dK/dV then dQ), O(T·d) memory, no (T, T) tensors.
+
+    ``k``/``v`` may carry a different T than ``q`` (one K/V SEGMENT of a
+    larger sequence): with a GLOBAL ``L``/``D_row``, the returned grads
+    are this segment's exact contribution, and contributions from
+    different segments SUM — the property the ring backward in
+    ``parallel/sequence`` is built on.  ``D_row`` (rowsum(dO·out) per q
+    row) defaults to being computed from ``out``/``g``; segment callers
+    pass the global value."""
+    if out is None and D_row is None:
+        raise ValueError("flash_attention_bwd needs `out` (to derive "
+                         "D = rowsum(dO*out)) or an explicit `D_row`")
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    block_q = _clamp_block(block_q, T)
-    block_k = _clamp_block(block_k, T)
-    pad_mult = math.lcm(block_q, block_k)
+    block_q = _clamp_block(block_q, Tq)
+    block_k = _clamp_block(block_k, Tk)
     bh = B * H
 
-    qt = _to_bhd(q, pad_mult)
-    kt, vt = _to_bhd(k, pad_mult), _to_bhd(v, pad_mult)
-    dot = _to_bhd(g.astype(jnp.float32), pad_mult)
-    Tp, Dp = qt.shape[1], qt.shape[2]
-    nq, nk = Tp // block_q, Tp // block_k
+    qt = _to_bhd(q, block_q)
+    kt, vt = _to_bhd(k, block_k), _to_bhd(v, block_k)
+    dot = _to_bhd(g.astype(jnp.float32), block_q)
+    Tqp, Dp = qt.shape[1], qt.shape[2]
+    nq, nk = Tqp // block_q, kt.shape[1] // block_k
 
     # D_i = rowsum(dO * O): cheap elementwise, stays in XLA
-    Drow = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                   axis=-1)                                   # (B, T, H)
-    Lt = _row_stat_to_bhd(L, pad_mult)
-    Dt = _row_stat_to_bhd(Drow, pad_mult)
+    Drow = (D_row if D_row is not None
+            else jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                         axis=-1))                            # (B, Tq, H)
+    Lt = _row_stat_to_bhd(L, block_q)
+    Dt = _row_stat_to_bhd(Drow, block_q)
 
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
-                  block_k=block_k, q_len=T, k_len=T, precision=precision)
+                  block_k=block_k, q_len=Tq, k_len=Tk, precision=precision)
+    Tkp = kt.shape[1]
     dk, dv = pl.pallas_call(
         _make_dkdv_kernel(num_q_blocks=nq, **common),
-        out_shape=[_sds((bh, Tp, Dp), jnp.float32, qt)] * 2,
+        out_shape=[_sds((bh, Tkp, Dp), jnp.float32, qt)] * 2,
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, ki, qi: (b, qi, 0)),
@@ -472,7 +485,7 @@ def flash_attention_bwd(q: Array, k: Array, v: Array, out: Array,
 
     dq = pl.pallas_call(
         _make_dq_kernel(num_k_blocks=nk, **common),
-        out_shape=_sds((bh, Tp, Dp), jnp.float32, qt),
+        out_shape=_sds((bh, Tqp, Dp), jnp.float32, qt),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
@@ -488,12 +501,14 @@ def flash_attention_bwd(q: Array, k: Array, v: Array, out: Array,
         interpret=interpret,
     )(qt, kt, vt, dot, Lt, Dt)
 
-    def back(x):
-        x = x[:, :T, :D].reshape(B, H, T, D)
+    def back(x, t):
+        x = x[:, :t, :D].reshape(B, H, t, D)
         return jnp.transpose(x, (0, 2, 1, 3))
 
-    return (back(dq).astype(q.dtype), back(dk).astype(k.dtype),
-            back(dv).astype(v.dtype))
+    # f32 out: segment callers (the ring backward) SUM contributions, and
+    # rounding each one to a low input dtype first would compound n-fold;
+    # the VJP boundary casts once
+    return back(dq, Tq), back(dk, Tk), back(dv, Tk)
 
 
 # --------------------------------------------------------------- custom VJP
@@ -521,10 +536,12 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, precision,
                fused_backward, res, g):
     q, k, v, out, L = res
     if fused_backward:
-        return flash_attention_bwd(
+        dq, dk, dv = flash_attention_bwd(
             q, k, v, out, L, g, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, interpret=interpret,
             precision=precision)
+        return (dq.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
     from ..parallel.sequence import _full_attention
     _, vjp = jax.vjp(
         lambda q, k, v: _full_attention(q, k, v, causal=causal,

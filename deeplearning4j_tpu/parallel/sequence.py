@@ -157,8 +157,10 @@ def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
     offsets coincide), or from a FUTURE chip (fully masked, skipped) —
     so the kernel never needs global position plumbing.
 
-    Differentiable: custom VJP recomputes through the einsum ring
-    (exact gradients; fused backward remains headroom).
+    Differentiable: the custom VJP is a FUSED ring backward — the q-side
+    package (q, dO, logsumexp, D, dq-accumulator) travels the ring and
+    every chip folds its local kv shard's exact contribution through the
+    Pallas backward kernels, so gradient memory is also O(T/n · d).
 
     ``interpret``/``precision`` thread through to the kernel —
     pass ``interpret=True`` when the mesh devices aren't the default
@@ -171,8 +173,9 @@ def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash_core(q, k, v, axis_name, causal, sm_scale, block_q,
                      block_k, interpret, precision):
-    return _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
-                               block_q, block_k, interpret, precision)
+    out, _ = _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
+                                 block_q, block_k, interpret, precision)
+    return out
 
 
 def _ring_flash_forward(q, k, v, axis_name, causal, sm_scale, block_q,
@@ -228,26 +231,87 @@ def _ring_flash_forward(q, k, v, axis_name, causal, sm_scale, block_q,
     m0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:3], jnp.float32)
     o0, m0, l0 = lax.pcast((o0, m0, l0), axis_name, to="varying")
-    (o, _, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
                                   jnp.arange(n))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l_safe)          # (out, per-row logsumexp)
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q,
                     block_k, interpret, precision):
-    out = _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
-                              block_q, block_k, interpret, precision)
-    return out, (q, k, v)
+    out, L = _ring_flash_forward(q, k, v, axis_name, causal, sm_scale,
+                                 block_q, block_k, interpret, precision)
+    return out, (q, k, v, out, L)
 
 
 def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k,
                     interpret, precision, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: ring_attention(q, k, v, axis_name=axis_name,
-                                       causal=causal, sm_scale=sm_scale),
-        q, k, v)
-    return vjp(g)
+    """FUSED ring backward: the q-side package (q, dO, L, D, dq-accum)
+    travels the ring; every chip folds its LOCAL kv shard's exact
+    gradient contribution via the fused flash backward kernels, so
+    backward memory stays O(T/n · d) per chip like the forward.
+
+    Causality mirrors the forward's three cases from the kv side: a
+    package from a LATER chip sees this kv shard fully (its q positions
+    are all past it), the home package is locally causal, and a package
+    from an EARLIER chip contributes nothing."""
+    from ..ops.attention import flash_attention_bwd
+
+    q, k, v, out, L = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = (float(sm_scale) if sm_scale is not None
+             else 1.0 / float(np.sqrt(q.shape[-1])))
+    kwargs = dict(sm_scale=scale, block_q=block_q, block_k=block_k,
+                  interpret=interpret, precision=precision)
+    D_row = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+
+    def contribution(local_causal):
+        def fn(pkg):
+            q_r, do_r, L_r, D_r = pkg
+            # contributions come back f32 and accumulate in f32; the
+            # single cast to input dtype happens at the VJP boundary
+            return flash_attention_bwd(
+                q_r, k, v, None, L_r, do_r, causal=local_causal,
+                D_row=D_r, **kwargs)
+        return fn
+
+    def masked(pkg):
+        # align vma with the kernel branches (fresh zeros are unvarying)
+        return lax.pcast(
+            (jnp.zeros(q.shape, jnp.float32),
+             jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32)),
+            axis_name, to="varying")
+
+    def body(carry, r):
+        (q_r, do_r, L_r, D_r, dq_r), dk_acc, dv_acc = carry
+        src = (my - r) % n                   # package origin
+        pkg = (q_r, do_r, L_r, D_r)
+        if causal:
+            # src > my: visitor's q positions all AFTER this kv -> full
+            case = jnp.where(src == my, 1, jnp.where(src > my, 0, 2))
+            dq_c, dk_c, dv_c = lax.switch(
+                case, [contribution(False), contribution(True), masked],
+                pkg)
+        else:
+            dq_c, dk_c, dv_c = contribution(False)(pkg)
+        dk_acc = dk_acc + dk_c
+        dv_acc = dv_acc + dv_c
+        moved = lax.ppermute((q_r, do_r, L_r, D_r, dq_r + dq_c),
+                             axis_name, _ring_perm(n))
+        return (moved, dk_acc, dv_acc), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq0, dk0, dv0 = lax.pcast((dq0, dk0, dv0), axis_name, to="varying")
+    carry0 = ((q, g, L, D_row, dq0), dk0, dv0)
+    ((_, _, _, _, dq), dk, dv), _ = lax.scan(body, carry0, jnp.arange(n))
+    # after n rotations the package (with its accumulated dq) is home
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 _ring_flash_core.defvjp(_ring_flash_fwd, _ring_flash_bwd)

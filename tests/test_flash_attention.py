@@ -170,7 +170,10 @@ def test_ring_flash_attention_matches_full(causal):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ring_flash_gradients_match_full():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients_match_full(causal):
+    """The FUSED ring backward (q-package rotation folding per-chip
+    Pallas contributions) must match the single-device oracle."""
     import functools
     from jax.sharding import Mesh, PartitionSpec as P
     from deeplearning4j_tpu.parallel.sequence import ring_flash_attention
@@ -178,17 +181,50 @@ def test_ring_flash_gradients_match_full():
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
     rf = jax.shard_map(
         functools.partial(ring_flash_attention, axis_name="seq",
-                          causal=True, block_q=8, block_k=8),
+                          causal=causal, block_q=8, block_k=8),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
         out_specs=P(None, "seq"))
     gf = jax.jit(jax.grad(lambda q, k, v: jnp.sum(rf(q, k, v) ** 2),
                           argnums=(0, 1, 2)))(q, k, v)
     gr = jax.grad(lambda q, k, v: jnp.sum(
-        _full_attention(q, k, v, causal=True) ** 2),
+        _full_attention(q, k, v, causal=causal) ** 2),
         argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_segment_contributions_sum():
+    """flash_attention_bwd over two KV segments with the GLOBAL L/D sums
+    to the full backward — the invariant the ring backward relies on."""
+    from deeplearning4j_tpu.ops.attention import (flash_attention_bwd,
+                                                  flash_attention_partial)
+    q, k, v = _qkv(t=32, d=16)
+    rng = np.random.RandomState(9)
+    g = jnp.asarray(rng.randn(*q.shape).astype(np.float32))
+    acc, m, l = flash_attention_partial(q, k, v, block_q=16, block_k=16)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    L = m + jnp.log(l_safe)
+    D = jnp.sum(g * out, axis=-1)
+    full = flash_attention_bwd(q, k, v, None, L, g, causal=False,
+                               sm_scale=1.0 / 4.0, block_q=16, block_k=16,
+                               D_row=D)
+    half = 16
+    seg0 = flash_attention_bwd(q, k[:, :half], v[:, :half], None, L, g,
+                               causal=False, sm_scale=1.0 / 4.0,
+                               block_q=16, block_k=16, D_row=D)
+    seg1 = flash_attention_bwd(q, k[:, half:], v[:, half:], None, L, g,
+                               causal=False, sm_scale=1.0 / 4.0,
+                               block_q=16, block_k=16, D_row=D)
+    np.testing.assert_allclose(np.asarray(seg0[0]) + np.asarray(seg1[0]),
+                               np.asarray(full[0]), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(seg0[1]), np.asarray(seg1[1])], axis=1),
+        np.asarray(full[1]), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(seg0[2]), np.asarray(seg1[2])], axis=1),
+        np.asarray(full[2]), rtol=2e-5, atol=2e-5)
 
 
 def test_sequence_parallel_ring_flash_impl():
