@@ -5,9 +5,10 @@ printed as exactly ONE JSON line
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 ``--all`` additionally benchmarks the other BASELINE configs (ResNet-50,
-GravesLSTM char-RNN, word2vec skip-gram pairs/sec) and — in a CPU
-subprocess with a virtual 8-device mesh — the ParallelWrapper scaling
-harness; those extra lines go to stderr so stdout stays one line.
+VGG-16, GravesLSTM char-RNN, word2vec skip-gram pairs/sec), the Pallas
+flash-attention training throughput at T=8192, and — in a CPU subprocess
+with a virtual 8-device mesh — the ParallelWrapper scaling harness;
+those extra lines go to stderr so stdout stays one line.
 
 Measurement notes: the round-1/2 harness timed 40 host dispatches (~6 ms of
 device work) against a tunneled TPU, which made the number dispatch-latency
@@ -296,6 +297,41 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
             "vs_baseline": None, "batch": batch}
 
 
+def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
+                          d_head: int = 64, steps: int = 4,
+                          trials: int = 3) -> dict:
+    """Pallas flash attention fwd+fused-bwd throughput at a sequence
+    length the XLA attention path cannot compile (linear-memory
+    long-context tier; see BASELINE.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(batch, seq, heads, d_head)
+                           .astype(np.float32)) for _ in range(3))
+    lossg = jax.jit(jax.value_and_grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2)))
+    loss, grads = lossg(q, k, v)
+    float(loss)                 # fetch = the reliable completion barrier
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, grads = lossg(q, k, v)
+        jax.block_until_ready(grads)
+        float(loss)
+        return time.perf_counter() - t0
+
+    elapsed = _best_of(timed, trials)
+    tokens = steps * batch * seq / elapsed
+    return {"metric": "flash_attention_train_tokens_per_sec_per_chip",
+            "value": round(tokens, 1), "unit": "tokens/sec/chip",
+            "vs_baseline": None, "batch": batch, "seq": seq}
+
+
 def bench_scaling() -> dict:
     """ParallelWrapper scaling efficiency 1→8 on a virtual CPU mesh, in a
     subprocess (the TPU session only has one real chip; the CPU mesh is the
@@ -337,7 +373,7 @@ def main() -> None:
     if not run_all:
         return
     for fn in (bench_resnet50, bench_vgg16, bench_lstm, bench_word2vec,
-               bench_scaling):
+               bench_flash_attention, bench_scaling):
         try:
             print(json.dumps(fn()), file=sys.stderr, flush=True)
         except Exception as e:  # keep going: one config failing is data too
