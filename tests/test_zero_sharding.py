@@ -1,0 +1,200 @@
+"""ZeRO-1 weight-update sharding tests (the cross-replica weight-update
+sharding technique of arXiv:2004.13336): semantics must be identical to
+replicated data parallelism, with n-fold smaller per-replica updater
+state."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.zero import ZeroShardedParallelWrapper
+
+
+def _conf(updater="adam", lr=0.05, l2=0.0, grad_norm=None, seed=77):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater).learning_rate(lr)
+         .activation("tanh").weight_init("xavier").dtype("float64"))
+    if l2:
+        b = b.l2(l2)
+    if grad_norm:
+        b = b.gradient_normalization(grad_norm)
+    return (b.list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+
+
+def _batches(n_batches, b=8, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        X = rng.randn(b, 4).astype(np.float64)
+        y = np.eye(3)[(X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)]
+        out.append(DataSet(X, y))
+    return out
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "rmsprop", "nesterovs",
+                                     "adagrad", "adadelta"])
+def test_zero_matches_single_process_big_batch(updater):
+    """w replicas x ZeRO step == one step on the concatenated batch, for
+    every stateful updater (grads pmean + identical update math)."""
+    w = 4
+    batches = _batches(w)
+    zero_net = MultiLayerNetwork(_conf(updater)).init()
+    ref_net = MultiLayerNetwork(_conf(updater)).init()
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params())
+    zw = ZeroShardedParallelWrapper(zero_net, workers=w)
+    zw.fit(batches)
+    big = DataSet(np.concatenate([np.asarray(b.features) for b in batches]),
+                  np.concatenate([np.asarray(b.labels) for b in batches]))
+    ref_net.fit(big)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_zero_multi_step_convergence_matches():
+    """Several consecutive ZeRO steps track the replicated path exactly —
+    the sharded updater STATE must evolve identically."""
+    w = 4
+    zero_net = MultiLayerNetwork(_conf("adam", l2=1e-3)).init()
+    ref_net = MultiLayerNetwork(_conf("adam", l2=1e-3)).init()
+    zw = ZeroShardedParallelWrapper(zero_net, workers=w)
+    for step in range(5):
+        batches = _batches(w, seed=step)
+        zw.fit(batches)
+        big = DataSet(
+            np.concatenate([np.asarray(b.features) for b in batches]),
+            np.concatenate([np.asarray(b.labels) for b in batches]))
+        ref_net.fit(big)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-8)
+    assert zero_net.iteration == ref_net.iteration == 5
+
+
+def test_zero_with_gradient_normalization():
+    w = 2
+    zero_net = MultiLayerNetwork(
+        _conf("sgd", grad_norm="ClipL2PerLayer")).init()
+    ref_net = MultiLayerNetwork(
+        _conf("sgd", grad_norm="ClipL2PerLayer")).init()
+    batches = _batches(w)
+    ZeroShardedParallelWrapper(zero_net, workers=w).fit(batches)
+    big = DataSet(np.concatenate([np.asarray(b.features) for b in batches]),
+                  np.concatenate([np.asarray(b.labels) for b in batches]))
+    ref_net.fit(big)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_zero_l2_plus_gradnorm_order_matches():
+    """l2 AND grad normalization together: the ZeRO path must apply them
+    in the replicated order (regularize THEN normalize)."""
+    w = 2
+    kw = dict(updater="sgd", l2=0.1, grad_norm="RenormalizeL2PerLayer")
+    zero_net = MultiLayerNetwork(_conf(**kw)).init()
+    ref_net = MultiLayerNetwork(_conf(**kw)).init()
+    batches = _batches(w)
+    ZeroShardedParallelWrapper(zero_net, workers=w).fit(batches)
+    big = DataSet(np.concatenate([np.asarray(b.features) for b in batches]),
+                  np.concatenate([np.asarray(b.labels) for b in batches]))
+    ref_net.fit(big)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_zero_syncs_model_updater_state():
+    """After ZeRO training, direct net.fit must resume with the TRAINED
+    adam moments, matching a fully-replicated run."""
+    w = 4
+    zero_net = MultiLayerNetwork(_conf("adam")).init()
+    ref_net = MultiLayerNetwork(_conf("adam")).init()
+    batches = _batches(w)
+    ZeroShardedParallelWrapper(zero_net, workers=w).fit(batches)
+    big = DataSet(np.concatenate([np.asarray(b.features) for b in batches]),
+                  np.concatenate([np.asarray(b.labels) for b in batches]))
+    ref_net.fit(big)
+    # now continue OUTSIDE the wrapper: states must have synced
+    follow = _batches(1, b=32, seed=99)[0]
+    zero_net.fit(follow)
+    ref_net.fit(follow)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_zero_threads_masks():
+    """Masked time-series DataSets train identically to the replicated
+    path (masks must not be silently dropped)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    w = 2
+
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(5).updater("sgd").learning_rate(0.1)
+                .weight_init("xavier").dtype("float64").list()
+                .layer(GravesLSTM(n_in=3, n_out=6, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=6, n_out=2))
+                .build())
+
+    rng = np.random.RandomState(8)
+    batches = []
+    for _ in range(w):
+        f = rng.randn(4, 5, 3)
+        l = np.eye(2)[rng.randint(0, 2, (4, 5))]
+        mask = (rng.rand(4, 5) > 0.3).astype(np.float64)
+        mask[:, 0] = 1.0
+        batches.append(DataSet(f, l, features_mask=mask, labels_mask=mask))
+    zero_net = MultiLayerNetwork(conf()).init()
+    ref_net = MultiLayerNetwork(conf()).init()
+    ZeroShardedParallelWrapper(zero_net, workers=w).fit(batches)
+    big = DataSet(
+        np.concatenate([np.asarray(b.features) for b in batches]),
+        np.concatenate([np.asarray(b.labels) for b in batches]),
+        features_mask=np.concatenate([np.asarray(b.features_mask)
+                                      for b in batches]),
+        labels_mask=np.concatenate([np.asarray(b.labels_mask)
+                                    for b in batches]))
+    ref_net.fit(big)
+    np.testing.assert_allclose(zero_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_zero_state_is_sharded_n_fold():
+    w = 4
+    net = MultiLayerNetwork(_conf("adam")).init()
+    zw = ZeroShardedParallelWrapper(net, workers=w)
+    total = net.get_flat_params().size
+    per_replica = zw.state_elements_per_replica()
+    # adam: m + v -> 2 state tensors of ceil(total/w) each
+    assert per_replica == 2 * (-(-total // w))
+    assert per_replica < 2 * total / (w - 1)
+
+
+def test_zero_rejects_per_layer_updater_overrides():
+    from deeplearning4j_tpu.nn.updaters import UpdaterConfig
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8,
+                              updater=UpdaterConfig(updater="adam")))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="ONE updater config"):
+        ZeroShardedParallelWrapper(net, workers=2)
