@@ -360,7 +360,8 @@ class ComputationGraph:
                     grads, layer.gradient_normalization,
                     layer.gradient_normalization_threshold)
                 updates, new_ustate = _updaters.compute_update(
-                    uconf, grads, ustate, iteration)
+                    uconf, grads, ustate, iteration,
+                    params={k: params[name][k] for k in grads})
                 new_p = jax.tree.map(lambda p, u: p - u, params[name],
                                      updates)
                 score = score + _updaters.regularization_score(

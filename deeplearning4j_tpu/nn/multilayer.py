@@ -457,7 +457,8 @@ class MultiLayerNetwork:
                     grads, layer.gradient_normalization,
                     layer.gradient_normalization_threshold)
                 updates, new_ustate = _updaters.compute_update(
-                    uconf, grads, ustate_i, iteration)
+                    uconf, grads, ustate_i, iteration,
+                    params={k: params[i][k] for k in grads})
                 new_p = jax.tree.map(lambda p, u: p - u, params[i], updates)
                 score = score + _updaters.regularization_score(
                     params[i], layer.l1_by_param(), layer.l2_by_param())

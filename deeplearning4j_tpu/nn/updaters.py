@@ -47,7 +47,7 @@ class UpdaterConfig:
     """Serializable updater hyperparameters (subset of
     ``NeuralNetConfiguration`` fields that feed ``LayerUpdater``)."""
 
-    updater: str = "sgd"              # sgd|adam|adadelta|nesterovs|rmsprop|adagrad|none
+    updater: str = "sgd"              # sgd|adam|adadelta|nesterovs|rmsprop|adagrad|lars|none
     learning_rate: float = 0.1
     # lr policy (reference LearningRatePolicy enum)
     lr_policy: str = "none"           # none|exponential|inverse|step|poly|sigmoid|schedule
@@ -67,6 +67,10 @@ class UpdaterConfig:
     # adadelta
     rho: float = 0.95
     epsilon: float = 1e-6
+    # lars (beyond the 2016 reference; the large-batch layer-wise
+    # adaptive-rate technique of the MLPerf-on-TPU-pods literature)
+    lars_trust_coefficient: float = 0.001
+    lars_weight_decay: float = 0.0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -189,15 +193,21 @@ def init_state(conf: UpdaterConfig, params: ParamTree) -> ParamTree:
         return {"m": zeros(), "v": zeros()}
     if name == "adadelta":
         return {"msg": zeros(), "msdx": zeros()}
+    if name == "lars":
+        return {"v": zeros()}
     raise ValueError(f"Unknown updater '{conf.updater}'")
 
 
 def compute_update(conf: UpdaterConfig, grads: ParamTree, state: ParamTree,
-                   iteration: Array) -> tuple[ParamTree, ParamTree]:
+                   iteration: Array,
+                   params: Optional[ParamTree] = None
+                   ) -> tuple[ParamTree, ParamTree]:
     """Turn raw (regularized, normalized) grads into the step to subtract.
 
     Returns ``(updates, new_state)``; caller does ``params -= updates``
-    (reference ``NegativeGradientStepFunction`` semantics).
+    (reference ``NegativeGradientStepFunction`` semantics).  ``params``
+    is only consulted by updaters whose step depends on the weights
+    themselves (lars); tree-structure must then match ``grads``.
     """
     name = conf.updater.lower()
     lr = learning_rate_for(conf, iteration)
@@ -253,6 +263,30 @@ def compute_update(conf: UpdaterConfig, grads: ParamTree, state: ParamTree,
             lambda d, u: rho * d + (1 - rho) * jnp.square(u),
             state["msdx"], updates)
         return updates, {"msg": msg, "msdx": msdx}
+    if name == "lars":
+        # Layer-wise Adaptive Rate Scaling (You et al. 2017), the
+        # large-batch recipe of the MLPerf TPU-pod scaling literature:
+        # per-tensor trust ratio eta*||w|| / (||g|| + wd*||w||) scales the
+        # momentum step so every layer moves proportionally to its
+        # weight scale.
+        if params is None:
+            raise ValueError("lars needs the params tree (trust ratios "
+                             "are weight-norm relative)")
+        eta = conf.lars_trust_coefficient
+        wd = conf.lars_weight_decay
+        mu = momentum_for(conf, iteration)
+
+        def one(w, g, v):
+            w_norm = jnp.linalg.norm(w.ravel())
+            g_norm = jnp.linalg.norm(g.ravel())
+            trust = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                eta * w_norm / (g_norm + wd * w_norm + 1e-12), 1.0)
+            v_new = mu * v + lr * trust * (g + wd * w)
+            return v_new
+
+        v_new = jax.tree.map(one, params, grads, state["v"])
+        return v_new, {"v": v_new}
     raise ValueError(f"Unknown updater '{conf.updater}'")
 
 
@@ -279,7 +313,9 @@ def apply_layer_updates(uconf: UpdaterConfig, layer, params: ParamTree,
     g = regularize(g, params, layer.l1_by_param(), layer.l2_by_param())
     g = normalize_gradients(g, layer.gradient_normalization,
                             layer.gradient_normalization_threshold)
-    updates, new_state = compute_update(uconf, g, state, iteration)
+    updates, new_state = compute_update(
+        uconf, g, state, iteration,
+        params={k: params[k] for k in g})
     new_params = dict(params)
     for k, u in updates.items():
         new_params[k] = params[k] - u

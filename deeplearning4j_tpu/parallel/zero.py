@@ -83,6 +83,10 @@ class ZeroShardedParallelWrapper:
                 raise ValueError(
                     f"layer {type(l).__name__} uses direct-update params "
                     f"(unsupported under ZeRO sharding)")
+        if first.updater.lower() == "lars":
+            raise ValueError(
+                "lars computes per-TENSOR trust ratios; flat-slice "
+                "sharding would break them — use replicated DP for lars")
         self.uconf = first
 
     # ---- static flat metadata --------------------------------------------

@@ -156,3 +156,83 @@ def test_serde_roundtrip():
     d = conf.to_dict()
     back = updaters.UpdaterConfig.from_dict(d)
     assert back == conf
+
+
+# ----------------------------------------------------------------- lars
+
+def test_lars_trust_ratio_scales_per_tensor():
+    """LARS (You et al. 2017; the MLPerf TPU-pod large-batch recipe):
+    step magnitude per tensor follows eta*||w||/(||g||+wd*||w||)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.updaters import (UpdaterConfig,
+                                                compute_update, init_state)
+    conf = UpdaterConfig(updater="lars", learning_rate=1.0, momentum=0.0,
+                         lars_trust_coefficient=0.01,
+                         lars_weight_decay=0.0)
+    w = {"W": jnp.full((4, 4), 2.0), "b": jnp.full((4,), 0.5)}
+    g = {"W": jnp.full((4, 4), 1.0), "b": jnp.full((4,), 1.0)}
+    state = init_state(conf, w)
+    updates, new_state = compute_update(conf, g, state, 0, params=w)
+    # trust = eta * ||w|| / ||g||; step = lr * trust * g
+    for k in ("W", "b"):
+        w_norm = float(jnp.linalg.norm(w[k].ravel()))
+        g_norm = float(jnp.linalg.norm(g[k].ravel()))
+        expect = 0.01 * w_norm / g_norm
+        np.testing.assert_allclose(np.asarray(updates[k]),
+                                   expect * np.asarray(g[k]), rtol=1e-5)
+    # momentum state recorded
+    np.testing.assert_allclose(np.asarray(new_state["v"]["W"]),
+                               np.asarray(updates["W"]))
+
+
+def test_lars_momentum_and_weight_decay():
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.updaters import (UpdaterConfig,
+                                                compute_update, init_state)
+    conf = UpdaterConfig(updater="lars", learning_rate=0.5, momentum=0.9,
+                         lars_trust_coefficient=0.02,
+                         lars_weight_decay=1e-4)
+    w = {"W": jnp.ones((3, 3))}
+    g = {"W": jnp.full((3, 3), 0.1)}
+    state = init_state(conf, w)
+    u1, s1 = compute_update(conf, g, state, 0, params=w)
+    u2, s2 = compute_update(conf, g, s1, 1, params=w)
+    # closed form of step 1: lr * trust * (g + wd*w), trust from RAW ||g||
+    w_norm = float(jnp.linalg.norm(w["W"].ravel()))
+    g_norm = float(jnp.linalg.norm(g["W"].ravel()))
+    trust = 0.02 * w_norm / (g_norm + 1e-4 * w_norm + 1e-12)
+    expect1 = 0.5 * trust * (np.asarray(g["W"]) + 1e-4 * np.asarray(w["W"]))
+    np.testing.assert_allclose(np.asarray(u1["W"]), expect1, rtol=1e-5)
+    # second step adds heavy-ball momentum of the first
+    np.testing.assert_allclose(np.asarray(u2["W"]),
+                               0.9 * expect1 + expect1, rtol=1e-5)
+
+
+def test_lars_network_trains():
+    """End-to-end: a net configured with updater('lars') fits."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import inputs
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("lars").learning_rate(2.0)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(X[:, 0] > 0).astype(int)
+                                    + (X[:, 1] > 0).astype(int)]
+    before = float(net.score(DataSet(X, y)))
+    for _ in range(60):
+        net.fit(DataSet(X, y))
+    after = float(net.score(DataSet(X, y)))
+    assert after < before * 0.7, (before, after)
