@@ -1,0 +1,113 @@
+"""Pipeline-parallelism tests on the virtual mesh: GPipe-style staged
+execution must match serial training exactly (microbatched loss mean ==
+full-batch loss when microbatches are equal-sized)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.pipeline import (PipelineParallel,
+                                                  partition_stages)
+
+
+def _conf(widths=(16, 12, 8), updater="sgd", lr=0.2, seed=11):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater).learning_rate(lr)
+         .activation("tanh").weight_init("xavier").dtype("float64")
+         .list())
+    for w in widths:
+        b = b.layer(DenseLayer(n_out=w))
+    b = b.layer(OutputLayer(n_out=3))
+    return b.set_input_type(inputs.feed_forward(6)).build()
+
+
+def _data(b=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(b, 6)
+    y = np.eye(3)[(X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)]
+    return DataSet(X, y)
+
+
+def test_partition_stages_balanced_and_contiguous():
+    conf = _conf(widths=(32, 16, 8, 8))
+    net = MultiLayerNetwork(conf).init()
+    ranges = partition_stages(net.layers, net.params, 3)
+    assert ranges[0][0] == 0 and ranges[-1][1] == len(net.layers)
+    for (a, b), (c, d) in zip(ranges, ranges[1:]):
+        assert b == c and a < b
+    assert all(a < b for a, b in ranges)
+
+
+@pytest.mark.parametrize("stages,microbatches", [(2, 4), (4, 4), (4, 8)])
+def test_pipeline_matches_serial_training(stages, microbatches):
+    """One pipelined step == one serial step on the same batch (the
+    microbatch loss mean equals the full-batch loss mean)."""
+    pp_net = MultiLayerNetwork(_conf()).init()
+    ref_net = MultiLayerNetwork(_conf()).init()
+    np.testing.assert_allclose(pp_net.get_flat_params(),
+                               ref_net.get_flat_params())
+    ds = _data()
+    pp = PipelineParallel(pp_net, stages=stages,
+                          microbatches=microbatches,
+                          devices=jax.devices()[:stages])
+    pp.fit([ds])
+    ref_net.fit(ds)
+    np.testing.assert_allclose(pp_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-7, atol=1e-9)
+    assert pp_net.iteration == ref_net.iteration == 1
+
+
+def test_pipeline_multi_step_adam_matches():
+    """Several adam steps through the pipeline track serial training
+    (updater state evolves identically)."""
+    pp_net = MultiLayerNetwork(_conf(updater="adam", lr=0.01)).init()
+    ref_net = MultiLayerNetwork(_conf(updater="adam", lr=0.01)).init()
+    pp = PipelineParallel(pp_net, stages=4, microbatches=4,
+                          devices=jax.devices()[:4])
+    for step in range(4):
+        ds = _data(seed=step)
+        pp.fit([ds])
+        ref_net.fit(ds)
+    np.testing.assert_allclose(pp_net.get_flat_params(),
+                               ref_net.get_flat_params(),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_pipeline_scope_checks():
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelineParallel(net, stages=2, microbatches=3,
+                         devices=jax.devices()[:2]).fit([_data(b=16)])
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, dropout=0.5))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.feed_forward(4)).build())
+    with pytest.raises(ValueError, match="dropout"):
+        PipelineParallel(MultiLayerNetwork(conf).init(), stages=2,
+                         devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="stages > "):
+        PipelineParallel(MultiLayerNetwork(_conf()).init(), stages=5,
+                         devices=jax.devices()[:5])
+
+
+def test_pipeline_rejects_recurrent():
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(GravesLSTM(n_in=3, n_out=4))
+            .layer(RnnOutputLayer(n_in=4, n_out=2))
+            .build())
+    with pytest.raises(ValueError, match="not feed-forward"):
+        PipelineParallel(MultiLayerNetwork(conf).init(), stages=2,
+                         devices=jax.devices()[:2])
