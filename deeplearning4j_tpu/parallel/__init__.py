@@ -1,4 +1,16 @@
-"""Data-parallel training (reference deeplearning4j-scaleout tier)."""
+"""Parallelism tier (reference deeplearning4j-scaleout role, extended).
+
+- :mod:`parallel_wrapper` — data parallelism with local-SGD parameter
+  averaging (the reference ParallelWrapper semantics as lockstep SPMD).
+- :mod:`zero` — ZeRO-1 cross-replica weight-update sharding.
+- :mod:`pipeline` — GPipe-style pipeline parallelism over a stage axis.
+- :mod:`sequence` — ring / Ulysses / ring+flash sequence parallelism
+  and the sequence-parallel LSTM scan.
+- :mod:`scaling` — 1→N scaling-efficiency harness.
+"""
 
 from .parallel_wrapper import ParallelWrapper  # noqa: F401
+from .pipeline import PipelineParallel  # noqa: F401
 from .scaling import measure_throughput, scaling_report  # noqa: F401
+from .sequence import SequenceParallel  # noqa: F401
+from .zero import ZeroShardedParallelWrapper  # noqa: F401
