@@ -18,7 +18,8 @@ from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
 from .records import (AlignmentMode, CollectionRecordReader,
                       CollectionSequenceRecordReader, CSVRecordReader,
                       CSVSequenceRecordReader, RecordReader,
-                      RecordReaderDataSetIterator, SequenceRecordReader,
+                      RecordReaderDataSetIterator,
+                      RecordReaderMultiDataSetIterator, SequenceRecordReader,
                       SequenceRecordReaderDataSetIterator)
 
 __all__ = [
@@ -30,6 +31,7 @@ __all__ = [
     "ImagePreProcessingScaler", "load_normalizer", "RecordReader",
     "CollectionRecordReader", "CSVRecordReader", "SequenceRecordReader",
     "CollectionSequenceRecordReader", "CSVSequenceRecordReader",
-    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
     "AlignmentMode",
 ]

@@ -66,4 +66,5 @@ class MultiDataSet:
     labels_masks: Optional[Sequence[Optional[np.ndarray | Array]]] = None
 
     def num_examples(self) -> int:
-        return int(self.features[0].shape[0])
+        arrs = self.features if len(self.features) else self.labels
+        return int(arrs[0].shape[0])

@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
-from .dataset import DataSet
+from .dataset import DataSet, MultiDataSet
 from .iterators import DataSetIterator
 
 Record = List[Union[float, int, str]]
@@ -158,6 +158,20 @@ def _one_hot(values: np.ndarray, num_classes: int) -> np.ndarray:
     return np.eye(num_classes, dtype=np.float32)[idx]
 
 
+def _pad_sequences(steps: List[np.ndarray], T: int, align_end: bool):
+    """Variable-length (T_i, dim) matrices → ((n, T, dim), (n, T) mask),
+    occupying leading steps (trailing mask) or trailing steps under
+    ALIGN_END."""
+    n = len(steps)
+    arr = np.zeros((n, T, steps[0].shape[1]), np.float32)
+    mask = np.zeros((n, T), np.float32)
+    for i, s in enumerate(steps):
+        off = T - s.shape[0] if align_end else 0
+        arr[i, off:off + s.shape[0]] = s
+        mask[i, off:off + s.shape[0]] = 1.0
+    return arr, mask
+
+
 class RecordReaderDataSetIterator(DataSetIterator):
     """Records → minibatch DataSets (reference
     ``RecordReaderDataSetIterator.java``).
@@ -292,20 +306,231 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                     "EQUAL_LENGTH alignment requires equal sequence "
                     f"lengths, got features {flens} labels {llens}")
         T = max(max(flens), max(llens))
-        fdim = fseqs[0].shape[1]
-        ldim = lseqs[0].shape[1]
-        feats = np.zeros((n, T, fdim), np.float32)
-        labels = np.zeros((n, T, ldim), np.float32)
-        fmask = np.zeros((n, T), np.float32)
-        lmask = np.zeros((n, T), np.float32)
         align_end = self.alignment_mode == AlignmentMode.ALIGN_END
-        for i, (fs, ls) in enumerate(zip(fseqs, lseqs)):
-            fo = T - fs.shape[0] if align_end else 0
-            lo = T - ls.shape[0] if align_end else 0
-            feats[i, fo:fo + fs.shape[0]] = fs
-            fmask[i, fo:fo + fs.shape[0]] = 1.0
-            labels[i, lo:lo + ls.shape[0]] = ls
-            lmask[i, lo:lo + ls.shape[0]] = 1.0
+        feats, fmask = _pad_sequences(fseqs, T, align_end)
+        labels, lmask = _pad_sequences(lseqs, T, align_end)
         if self.alignment_mode == AlignmentMode.EQUAL_LENGTH:
             return self._pre(DataSet(feats, labels))
         return self._pre(DataSet(feats, labels, fmask, lmask))
+
+
+# ----------------------------------------- multi-reader → MultiDataSet
+
+class _SubsetDetails:
+    """One input/output spec (reference
+    ``RecordReaderMultiDataSetIterator.SubsetDetails``): the whole reader,
+    a [first, last]-inclusive column subset, or a one-hot column."""
+
+    def __init__(self, reader_name: str, entire: bool, one_hot: bool,
+                 num_classes: int, col_first: int, col_last: int):
+        self.reader_name = reader_name
+        self.entire = entire
+        self.one_hot = one_hot
+        self.num_classes = num_classes
+        self.col_first = col_first
+        self.col_last = col_last
+
+    def convert(self, mat: np.ndarray) -> np.ndarray:
+        """(n, columns) record matrix → (n, dim) array for this subset."""
+        if self.entire:
+            return mat.astype(np.float32)
+        if self.one_hot:
+            return _one_hot(mat[:, self.col_first], self.num_classes)
+        return mat[:, self.col_first:self.col_last + 1].astype(np.float32)
+
+
+class RecordReaderMultiDataSetIterator:
+    """Multiple named Record/SequenceRecordReaders → MultiDataSet batches
+    (reference ``datasets/datavec/RecordReaderMultiDataSetIterator.java``:
+    builder at ``:504-620``, per-subset conversion at ``:253-311``).
+
+    Inputs and outputs are column subsets of any registered reader, so one
+    CSV can feed several graph inputs and several one-hot outputs at once.
+    Sequence readers emit (batch, time, dim) padded arrays with per-subset
+    masks under ``ALIGN_START`` / ``ALIGN_END``; record readers emit
+    (batch, dim) with no mask.  Built for ``ComputationGraph.fit``.
+    """
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            if batch_size <= 0:
+                raise ValueError("batch size must be positive")
+            self._batch = batch_size
+            self._readers = {}
+            self._seq_readers = {}
+            self._inputs: List[_SubsetDetails] = []
+            self._outputs: List[_SubsetDetails] = []
+            self._alignment = AlignmentMode.EQUAL_LENGTH
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self._readers[name] = reader
+            return self
+
+        def add_sequence_reader(self, name: str, reader: SequenceRecordReader):
+            self._seq_readers[name] = reader
+            return self
+
+        def sequence_alignment_mode(self, mode: str):
+            valid = (AlignmentMode.EQUAL_LENGTH, AlignmentMode.ALIGN_START,
+                     AlignmentMode.ALIGN_END)
+            if mode not in valid:
+                raise ValueError(f"unknown alignment mode {mode!r}; "
+                                 f"use one of {valid}")
+            self._alignment = mode
+            return self
+
+        @staticmethod
+        def _subset(name, column_first, column_last):
+            if column_first < 0:
+                if column_last >= 0:
+                    raise ValueError(
+                        f"column_last={column_last} given without "
+                        f"column_first for reader {name!r}")
+                return _SubsetDetails(name, True, False, -1, -1, -1)
+            if column_last < 0:
+                column_last = column_first      # single-column subset
+            if column_last < column_first:
+                raise ValueError(
+                    f"column_last {column_last} < column_first "
+                    f"{column_first} for reader {name!r}")
+            return _SubsetDetails(name, False, False, -1, column_first,
+                                  column_last)
+
+        def add_input(self, name: str, column_first: int = -1,
+                      column_last: int = -1):
+            self._inputs.append(self._subset(name, column_first, column_last))
+            return self
+
+        def add_input_one_hot(self, name: str, column: int, num_classes: int):
+            self._inputs.append(_SubsetDetails(
+                name, False, True, num_classes, column, -1))
+            return self
+
+        def add_output(self, name: str, column_first: int = -1,
+                       column_last: int = -1):
+            self._outputs.append(self._subset(name, column_first,
+                                              column_last))
+            return self
+
+        def add_output_one_hot(self, name: str, column: int,
+                               num_classes: int):
+            self._outputs.append(_SubsetDetails(
+                name, False, True, num_classes, column, -1))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            if not self._readers and not self._seq_readers:
+                raise ValueError("no readers registered")
+            if not self._inputs and not self._outputs:
+                raise ValueError("no inputs/outputs registered")
+            dup = set(self._readers) & set(self._seq_readers)
+            if dup:
+                raise ValueError(
+                    f"names registered as both record and sequence "
+                    f"readers: {sorted(dup)}")
+            known = set(self._readers) | set(self._seq_readers)
+            for d in self._inputs + self._outputs:
+                if d.reader_name not in known:
+                    raise ValueError(
+                        f"subset references unknown reader "
+                        f"{d.reader_name!r}; registered: {sorted(known)}")
+            return RecordReaderMultiDataSetIterator(self)
+
+    def __init__(self, builder: "RecordReaderMultiDataSetIterator.Builder"):
+        self._batch = builder._batch
+        self._readers = dict(builder._readers)
+        self._seq_readers = dict(builder._seq_readers)
+        self._inputs = list(builder._inputs)
+        self._outputs = list(builder._outputs)
+        self._alignment = builder._alignment
+        self._preprocessor = None
+
+    # reference MultiDataSetIterator.setPreProcessor
+    def set_preprocessor(self, preprocessor) -> None:
+        self._preprocessor = preprocessor
+
+    def batch(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        for r in self._readers.values():
+            r.reset()
+        for r in self._seq_readers.values():
+            r.reset()
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def _next_values(self):
+        """Pull up to batch_size examples from every reader; truncate all
+        to the minimum count so examples stay row-aligned (reference
+        ``minExamples`` logic at ``next(int):...``)."""
+        recs = {}
+        for name, r in self._readers.items():
+            rows = []
+            while r.has_next() and len(rows) < self._batch:
+                rows.append(r.next_record())
+            recs[name] = rows
+        seqs = {}
+        for name, r in self._seq_readers.items():
+            ss = []
+            while r.has_next() and len(ss) < self._batch:
+                ss.append(r.next_sequence())
+            seqs[name] = ss
+        counts = [len(v) for v in recs.values()] + \
+                 [len(v) for v in seqs.values()]
+        n = min(counts)
+        if n == 0:
+            raise StopIteration
+        return ({k: v[:n] for k, v in recs.items()},
+                {k: v[:n] for k, v in seqs.items()}, n)
+
+    def _convert_seq(self, details: _SubsetDetails, seq_mats):
+        """Per-sequence (T_i, columns) matrices → ((n, T, dim), mask).
+
+        The mask is always an array (all-ones when every sequence is full
+        length) so the MultiDataSet pytree structure — and therefore the
+        jitted train step's signature — is identical across batches.
+        """
+        steps = [details.convert(mat) for mat in seq_mats]
+        lens = [s.shape[0] for s in steps]
+        T = max(lens)
+        if self._alignment == AlignmentMode.EQUAL_LENGTH \
+                and len(set(lens)) > 1:
+            raise ValueError(
+                f"EQUAL_LENGTH alignment requires equal sequence lengths, "
+                f"got {lens} from reader {details.reader_name!r}")
+        return _pad_sequences(
+            steps, T, self._alignment == AlignmentMode.ALIGN_END)
+
+    def __next__(self) -> MultiDataSet:
+        recs, seqs, n = self._next_values()
+        rec_mats = {k: np.asarray(v, dtype=np.float32)
+                    for k, v in recs.items()}
+        seq_mats = {k: [np.asarray(s, dtype=np.float32) for s in v]
+                    for k, v in seqs.items()}
+
+        def convert(details: _SubsetDetails):
+            if details.reader_name in rec_mats:
+                return details.convert(rec_mats[details.reader_name]), None
+            return self._convert_seq(details, seq_mats[details.reader_name])
+
+        feats, fmasks = zip(*[convert(d) for d in self._inputs]) \
+            if self._inputs else ((), ())
+        labels, lmasks = zip(*[convert(d) for d in self._outputs]) \
+            if self._outputs else ((), ())
+        # Mask presence depends only on static config (alignment mode +
+        # whether any subset reads a sequence reader), never on this
+        # batch's lengths — a flipping pytree structure would retrigger
+        # jit compilation of the train step every time it changed.
+        emit = (self._alignment != AlignmentMode.EQUAL_LENGTH
+                and any(d.reader_name in self._seq_readers
+                        for d in self._inputs + self._outputs))
+        mds = MultiDataSet(
+            features=list(feats), labels=list(labels),
+            features_masks=list(fmasks) if emit else None,
+            labels_masks=list(lmasks) if emit else None)
+        if self._preprocessor is not None:
+            self._preprocessor.preprocess(mds)
+        return mds

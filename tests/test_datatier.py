@@ -318,3 +318,185 @@ class TestNormalizers:
             loaded = load_normalizer(p)
             np.testing.assert_allclose(loaded.transform(x),
                                        norm.transform(x), atol=1e-6)
+
+
+# ------------------------------------- RecordReaderMultiDataSetIterator
+
+class TestRecordReaderMultiDataSetIterator:
+    """Reference ``RecordReaderMultiDataSetIteratorTest``: column subsets,
+    one-hot outputs, multiple readers, sequence masks."""
+
+    def _reader(self):
+        # columns: [f0, f1, f2, label]
+        rows = [[i, i * 0.5, i * 2.0, i % 3] for i in range(7)]
+        return CollectionRecordReader(rows)
+
+    def test_subsets_and_one_hot(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        it = (RecordReaderMultiDataSetIterator.Builder(4)
+              .add_reader("r", self._reader())
+              .add_input("r", 0, 1)
+              .add_input("r", 2, 2)
+              .add_output_one_hot("r", 3, 3)
+              .build())
+        mds = next(iter(it))
+        assert len(mds.features) == 2 and len(mds.labels) == 1
+        np.testing.assert_allclose(mds.features[0],
+                                   [[0, 0], [1, .5], [2, 1.], [3, 1.5]])
+        np.testing.assert_allclose(mds.features[1][:, 0], [0, 2, 4, 6])
+        np.testing.assert_allclose(mds.labels[0],
+                                   np.eye(3)[[0, 1, 2, 0]])
+        # second batch: remaining 3 rows
+        assert next(it).features[0].shape == (3, 2)
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_matches_single_reader_iterator(self):
+        """Whole-reader input + one-hot output == the plain
+        RecordReaderDataSetIterator on the same data."""
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        multi = (RecordReaderMultiDataSetIterator.Builder(4)
+                 .add_reader("r", self._reader())
+                 .add_input("r", 0, 2)
+                 .add_output_one_hot("r", 3, 3)
+                 .build())
+        single = RecordReaderDataSetIterator(
+            self._reader(), 4, label_index=3, num_possible_labels=3)
+        for mds, ds in zip(iter(multi), iter(single)):
+            np.testing.assert_allclose(mds.features[0], ds.features)
+            np.testing.assert_allclose(mds.labels[0], ds.labels)
+
+    def test_two_readers_row_aligned(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        ra = CollectionRecordReader([[i, i + 10] for i in range(5)])
+        rb = CollectionRecordReader([[i * 100, i % 2] for i in range(4)])
+        it = (RecordReaderMultiDataSetIterator.Builder(8)
+              .add_reader("a", ra).add_reader("b", rb)
+              .add_input("a")
+              .add_output_one_hot("b", 1, 2)
+              .build())
+        mds = next(iter(it))
+        # truncated to min(5, 4) examples so rows stay aligned
+        assert mds.features[0].shape == (4, 2)
+        assert mds.labels[0].shape == (4, 2)
+
+    def test_sequence_align_end_masks(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        seqs = [[[1, 0]] * 3, [[2, 1]] * 5]        # lengths 3 and 5
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .add_sequence_reader("s", CollectionSequenceRecordReader(seqs))
+              .sequence_alignment_mode(AlignmentMode.ALIGN_END)
+              .add_input("s", 0, 0)
+              .add_output_one_hot("s", 1, 2)
+              .build())
+        mds = next(iter(it))
+        assert mds.features[0].shape == (2, 5, 1)
+        assert mds.features_masks[0].shape == (2, 5)
+        np.testing.assert_allclose(mds.features_masks[0][0], [0, 0, 1, 1, 1])
+        np.testing.assert_allclose(mds.features_masks[0][1], [1] * 5)
+        # short sequence sits at the END under ALIGN_END
+        np.testing.assert_allclose(mds.features[0][0, :, 0], [0, 0, 1, 1, 1])
+
+    def test_equal_length_mismatch_raises(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        seqs = [[[1.0]] * 3, [[2.0]] * 4]
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .add_sequence_reader("s", CollectionSequenceRecordReader(seqs))
+              .add_input("s")
+              .add_output("s")
+              .build())
+        with pytest.raises(ValueError, match="EQUAL_LENGTH"):
+            next(iter(it))
+
+    def test_builder_validation(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        with pytest.raises(ValueError, match="batch"):
+            RecordReaderMultiDataSetIterator.Builder(0)
+        with pytest.raises(ValueError, match="no readers"):
+            RecordReaderMultiDataSetIterator.Builder(2).add_input("x").build()
+        with pytest.raises(ValueError, match="unknown reader"):
+            (RecordReaderMultiDataSetIterator.Builder(2)
+             .add_reader("r", self._reader()).add_input("oops").build())
+
+    def test_feeds_multi_input_graph(self):
+        """End-to-end: two inputs/one output into ComputationGraph.fit
+        (the reference's reason this class exists)."""
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.computation_graph import MergeVertex
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+        rng = np.random.RandomState(0)
+        rows = np.concatenate(
+            [rng.randn(12, 3), rng.randint(0, 2, (12, 1))], axis=1).tolist()
+        it = (RecordReaderMultiDataSetIterator.Builder(6)
+              .add_reader("r", CollectionRecordReader(rows))
+              .add_input("r", 0, 1)
+              .add_input("r", 2, 2)
+              .add_output_one_hot("r", 3, 2)
+              .build())
+        g = (NeuralNetConfiguration.builder().seed(0).graph_builder()
+             .add_inputs("in1", "in2")
+             .add_layer("d1", DenseLayer(n_in=2, n_out=4), "in1")
+             .add_layer("d2", DenseLayer(n_in=1, n_out=4), "in2")
+             .add_vertex("m", MergeVertex(), "d1", "d2")
+             .add_layer("out", OutputLayer(n_in=8, n_out=2), "m")
+             .set_outputs("out").build())
+        net = ComputationGraph(g)
+        net.init()
+        net.fit(it, epochs=2)
+        out = net.output(np.float32(rng.randn(3, 2)),
+                         np.float32(rng.randn(3, 1)))
+        assert out.shape == (3, 2)
+
+    def test_single_column_subset_and_bad_specs(self):
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        it = (RecordReaderMultiDataSetIterator.Builder(4)
+              .add_reader("r", self._reader())
+              .add_input("r", 2)                    # one-column subset
+              .add_output_one_hot("r", 3, 3)
+              .build())
+        assert next(iter(it)).features[0].shape == (4, 1)
+        with pytest.raises(ValueError, match="column_last"):
+            (RecordReaderMultiDataSetIterator.Builder(4)
+             .add_reader("r", self._reader()).add_input("r", 2, 1))
+        with pytest.raises(ValueError, match="alignment"):
+            (RecordReaderMultiDataSetIterator.Builder(4)
+             .sequence_alignment_mode("ALIGN_END"))     # wrong case
+        with pytest.raises(ValueError, match="both record and sequence"):
+            (RecordReaderMultiDataSetIterator.Builder(4)
+             .add_reader("x", self._reader())
+             .add_sequence_reader("x", CollectionSequenceRecordReader(
+                 [[[1.0]]]))
+             .add_input("x").build())
+
+    def test_mask_structure_stable_across_batches(self):
+        """Masks must be present (or absent) identically for every batch,
+        regardless of whether one batch happens to have uniform lengths."""
+        from deeplearning4j_tpu.datasets import \
+            RecordReaderMultiDataSetIterator
+        seqs = [[[1.0]] * 3, [[2.0]] * 5,           # batch 1: mixed
+                [[3.0]] * 4, [[4.0]] * 4]           # batch 2: uniform
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .add_sequence_reader("s", CollectionSequenceRecordReader(seqs))
+              .sequence_alignment_mode(AlignmentMode.ALIGN_START)
+              .add_input("s")
+              .add_output("s")
+              .build())
+        batches = list(iter(it))
+        assert len(batches) == 2
+        for mds in batches:
+            assert mds.features_masks is not None
+            assert mds.features_masks[0] is not None
+        np.testing.assert_allclose(batches[1].features_masks[0],
+                                   np.ones((2, 4)))
