@@ -53,7 +53,16 @@ td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 0.85em; }
 <table id="sys"></table>
 <h2>Model</h2>
 <table id="model"></table>
+<h2>System: memory (MB) vs iteration</h2>
+<svg id="memchart" class="chart" width="640" height="200"></svg>
+<h2>System: hardware</h2>
+<table id="hw"></table>
 <script>
+function esc(v) {                       // stats values may come from the
+  const d = document.createElement('div');  // unauthenticated /remote POST
+  d.textContent = String(v);                // path - never innerHTML them raw
+  return d.innerHTML;
+}
 function line(svg, series, labels) {
   svg.innerHTML = '';
   const W = svg.width.baseVal.value, H = svg.height.baseVal.value;
@@ -105,9 +114,20 @@ async function refresh() {
   document.getElementById('model').innerHTML =
     '<tr><th>param</th><th>mean |w|</th><th>mean |dw|</th><th>ratio</th>'
     + '</tr>' + Object.entries(md.params || {}).map(([k, v]) =>
-      '<tr><td>' + k + '</td><td>' + v.mean_mag.toPrecision(4) + '</td><td>'
-      + (v.update_mag || 0).toPrecision(4) + '</td><td>'
+      '<tr><td>' + esc(k) + '</td><td>' + v.mean_mag.toPrecision(4)
+      + '</td><td>' + (v.update_mag || 0).toPrecision(4) + '</td><td>'
       + (v.ratio || 0).toPrecision(4) + '</td></tr>').join('');
+  const sd = await (await fetch('train/system/data?sid=' + sid)).json();
+  const wk = Object.entries(sd.workers || {});
+  line(document.getElementById('memchart'),
+       wk.map(([w, d]) => ({pts: d.memory_vs_iter || []})));
+  const hwKeys = ['hostname','os','python','jax_version','backend',
+                  'device_count','device_kind'];
+  document.getElementById('hw').innerHTML =
+    '<tr><th>worker</th>' + hwKeys.map(k => '<th>' + k + '</th>').join('')
+    + '</tr>' + wk.map(([w, d]) => '<tr><td>' + esc(w) + '</td>'
+      + hwKeys.map(k => '<td>' + esc((d.hardware || {})[k] ?? '-')
+      + '</td>').join('') + '</tr>').join('');
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -195,6 +215,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.overview_data(sid))
         elif path == "/train/model/data":
             self._json(ui.model_data(sid))
+        elif path == "/train/system/data":
+            self._json(ui.system_data(sid))
         elif path == "/tsne":
             self._send(200, _TSNE_PAGE.encode(), "text/html")
         elif path == "/tsne/data":
@@ -323,6 +345,29 @@ class UIServer:
                                   "num_params", "hostname")}
                     break
         return data
+
+    def system_data(self, sid: Optional[str]) -> dict:
+        """System tab (reference ``TrainModule`` system tab: per-worker
+        memory-utilization chart + hardware info table)."""
+        workers: dict = {}
+        if sid is not None:
+            for wid in self.storage.list_worker_ids(sid, TYPE_ID):
+                ups = self.storage.get_all_updates(sid, TYPE_ID, wid)
+                ups.sort(key=lambda r: r.timestamp)
+                info = {}
+                static = self.storage.get_static_info(sid, TYPE_ID, wid)
+                if static:
+                    info = {k: static.data.get(k)
+                            for k in ("hostname", "os", "python",
+                                      "jax_version", "backend",
+                                      "device_count", "device_kind")}
+                workers[wid] = {
+                    "hardware": info,
+                    "memory_vs_iter": [
+                        [u.data["iteration"], u.data["memory_rss_mb"]]
+                        for u in ups if "memory_rss_mb" in u.data],
+                }
+        return {"workers": workers}
 
     def model_data(self, sid: Optional[str]) -> dict:
         updates = self._updates(sid)

@@ -167,3 +167,94 @@ def test_early_stopping_parallel_trainer():
     result = trainer.fit()
     assert result.total_epochs == 4
     assert result.best_model_score < 2.0
+
+
+# ------------------------- Evaluation: top-N, FNR/FAR, metadata listings
+
+def test_top_n_accuracy():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    ev = Evaluation(top_n=2)
+    labels = np.eye(3)[[0, 1, 2, 0]]
+    # row 0: actual 0 ranked 1st; row 1: actual 1 ranked 2nd;
+    # row 2: actual 2 ranked 3rd; row 3: actual 0 ranked 2nd
+    preds = np.array([[.8, .1, .1],
+                      [.6, .3, .1],
+                      [.5, .3, .2],
+                      [.4, .5, .1]])
+    ev.eval(labels, preds)
+    assert ev.accuracy() == pytest.approx(0.25)
+    assert ev.top_n_accuracy() == pytest.approx(0.75)   # rows 0, 1, 3
+    assert f"Top-2" in ev.stats()
+    # top_n=1 falls back to accuracy
+    ev1 = Evaluation()
+    ev1.eval(labels, preds)
+    assert ev1.top_n_accuracy() == ev1.accuracy()
+
+
+def test_false_negative_and_alarm_rates():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    ev = Evaluation()
+    labels = np.eye(2)[[0, 0, 1, 1]]
+    preds = np.eye(2)[[0, 1, 1, 1]]     # one class-0 missed
+    ev.eval(labels, preds)
+    assert ev.false_negative_rate(0) == pytest.approx(0.5)
+    assert ev.false_negative_rate(1) == pytest.approx(0.0)
+    assert ev.false_negative_rate() == pytest.approx(0.25)
+    assert ev.false_positive_rate() == pytest.approx(0.25)
+    assert ev.false_alarm_rate() == pytest.approx(0.25)
+
+
+def test_prediction_metadata_listings():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation, Prediction
+    ev = Evaluation()
+    labels = np.eye(2)[[0, 0, 1, 1]]
+    preds = np.eye(2)[[0, 1, 1, 0]]
+    meta = ["rec0", "rec1", "rec2", "rec3"]
+    ev.eval(labels, preds, record_meta_data=meta)
+    errors = ev.get_prediction_errors()
+    assert [(p.actual, p.predicted, p.record_meta_data) for p in errors] \
+        == [(0, 1, "rec1"), (1, 0, "rec3")]
+    by_actual = ev.get_predictions_by_actual_class(0)
+    assert {p.record_meta_data for p in by_actual} == {"rec0", "rec1"}
+    by_pred = ev.get_predictions_by_predicted_class(1)
+    assert {p.record_meta_data for p in by_pred} == {"rec1", "rec2"}
+    assert [p.record_meta_data for p in ev.get_predictions(1, 0)] == ["rec3"]
+    # merge folds metadata
+    other = Evaluation()
+    other.eval(np.eye(2)[[1]], np.eye(2)[[0]], record_meta_data=["recX"])
+    ev.merge(other)
+    assert [p.record_meta_data for p in ev.get_predictions(1, 0)] \
+        == ["rec3", "recX"]
+    # without metadata the listings are None (reference contract)
+    plain = Evaluation()
+    plain.eval(labels, preds)
+    assert plain.get_prediction_errors() is None
+    # wrong-arity metadata rejected
+    with pytest.raises(ValueError, match="metadata"):
+        Evaluation().eval(labels, preds, record_meta_data=["only-one"])
+    # metadata on time series rejected
+    with pytest.raises(ValueError, match="time series"):
+        Evaluation().eval(labels.reshape(2, 2, 2), preds.reshape(2, 2, 2),
+                          record_meta_data=meta)
+
+
+def test_eval_metadata_arity_error_leaves_counters_untouched():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    ev = Evaluation()
+    labels = np.eye(2)[[0, 1]]
+    preds = np.eye(2)[[0, 1]]
+    with pytest.raises(ValueError, match="metadata"):
+        ev.eval(labels, preds, record_meta_data=["only-one"])
+    assert ev.confusion is None          # nothing accumulated
+    ev.eval(labels, preds, record_meta_data=["a", "b"])   # retry works
+    assert ev.accuracy() == 1.0
+
+
+def test_merge_top_n_mismatch_raises():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    a, b = Evaluation(top_n=3), Evaluation()
+    labels = np.eye(4)[[0, 1]]
+    a.eval(labels, labels)
+    b.eval(labels, labels)
+    with pytest.raises(ValueError, match="top_n"):
+        a.merge(b)

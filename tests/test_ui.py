@@ -161,6 +161,13 @@ def test_ui_server_end_to_end():
         assert "0_W" in md["params"]
         assert md["params"]["0_W"]["histogram"] is not None
         assert len(md["ratio_series"]["0_W"]) >= 2
+
+        sd = json.loads(urllib.request.urlopen(
+            base + f"/train/system/data?sid={listener.session_id}").read())
+        worker = sd["workers"][listener.worker_id]
+        assert worker["hardware"]["hostname"]
+        assert len(worker["memory_vs_iter"]) >= 1
+        assert all(mb > 0 for _, mb in worker["memory_vs_iter"])
     finally:
         server.stop()
 
