@@ -263,6 +263,97 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
+    def _multi_train_step(self):
+        """S sequential graph train steps in ONE XLA program via
+        ``lax.scan`` over per-input stacked (S, B, ...) batches — the graph
+        twin of ``MultiLayerNetwork._multi_train_step``.  One dispatch runs
+        the whole loop on-chip, so throughput is set by the MXU rather
+        than by host→device dispatch latency (the reference's inner loop
+        is host-driven, ``StochasticGradientDescent.java:50-72``)."""
+
+        def multi(params, updater_state, net_state, iteration, features,
+                  labels, features_masks, labels_masks, base_rng):
+            def body(carry, xs):
+                p, u, s, it = carry
+                f, l, fm, lm = xs
+                rng = jax.random.fold_in(base_rng, it)
+                (data_loss, (new_s, _)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(
+                        p, s, f, l, fm, lm, rng, True)
+                new_p, new_u = self._apply_updates(p, u, grads, it)
+                score = data_loss + self._reg_score(p)
+                return (new_p, new_u, new_s, it + 1), score
+
+            init = (params, updater_state, net_state,
+                    jnp.asarray(iteration, jnp.int32))
+            (params, updater_state, net_state, _), scores = jax.lax.scan(
+                body, init,
+                (features, labels, features_masks, labels_masks))
+            return params, updater_state, net_state, scores
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def fit_scan(self, batches) -> "np.ndarray":
+        """Fit a list of same-shaped DataSet/MultiDataSet minibatches in one
+        device dispatch (scan-based inner loop); returns per-step scores.
+        Listeners fire once at the end.  Standard-backprop regime only —
+        tBPTT / pretraining / num_iterations>1 / solver configs raise."""
+        self.init()
+        if getattr(self.conf, "backprop_type", "standard") == "tbptt":
+            raise ValueError("fit_scan does not support tBPTT; use fit()")
+        if self.conf.pretrain and not self._pretrain_done:
+            raise ValueError("fit_scan does not run pretraining; call "
+                             "pretrain() (or fit()) first")
+        if self.conf.conf.num_iterations != 1:
+            raise ValueError("fit_scan runs one update per batch; "
+                             "num_iterations > 1 must use fit()")
+        if self._solver is not None:
+            raise ValueError("fit_scan supports the SGD path only; this "
+                             "config uses a line-search solver")
+        mbs = [_as_multi(b) for b in batches]
+
+        def stack_inputs(get, count):
+            return [jnp.stack([jnp.asarray(get(m)[i]) for m in mbs])
+                    for i in range(count)]
+
+        def stack_masks(get, count):
+            if all(get(m) is None for m in mbs):
+                return None
+            # presence must agree per input INDEX across batches: batch 0
+            # is not a template (masks are Sequence[Optional[array]])
+            out = []
+            for i in range(count):
+                present = [get(m) is not None and get(m)[i] is not None
+                           for m in mbs]
+                if not any(present):
+                    out.append(None)
+                    continue
+                if not all(present):
+                    raise ValueError(
+                        f"Mixed mask presence across batches for input "
+                        f"{i} in fit_scan; provide masks on all batches "
+                        f"or none")
+                out.append(jnp.stack([jnp.asarray(get(m)[i]) for m in mbs]))
+            return out
+
+        n_in = len(mbs[0].features)
+        n_out = len(mbs[0].labels)
+        features = stack_inputs(lambda m: m.features, n_in)
+        labels = stack_inputs(lambda m: m.labels, n_out)
+        fmasks = stack_masks(lambda m: m.features_masks, n_in)
+        lmasks = stack_masks(lambda m: m.labels_masks, n_out)
+        (self.params, self.updater_state, self.net_state,
+         scores) = self._multi_train_step(
+            self.params, self.updater_state, self.net_state, self.iteration,
+            features, labels, fmasks, lmasks, self._rng_key)
+        self.iteration += len(mbs)
+        self._score = scores[-1]
+        self.last_batch_size = mbs[0].num_examples()
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return np.asarray(scores)
+
+    @functools.cached_property
     def _tbptt_step(self):
         """Truncated-BPTT window step for the graph (reference graph tBPTT
         path in ``ComputationGraph.doTruncatedBPTT:1936``): one

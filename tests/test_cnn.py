@@ -226,3 +226,25 @@ def test_lenet_trains_mnist():
     accs = [net.evaluate(b).accuracy() for b in test_it]
     acc = float(np.mean(accs))
     assert acc > 0.98, f"accuracy {acc}"
+
+
+def test_batch_norm_scalar_gamma_gradient_shape():
+    """lock_gamma_beta passes scalar gamma/beta; the fused BN backward must
+    collapse dgamma/dbeta to the primal (scalar) shape like autodiff did."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.convolution import batch_norm_train
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+
+    def loss(g, b):
+        out, _, _ = batch_norm_train(x, g, b, (0,), 1e-5)
+        return jnp.sum(out ** 2)
+
+    dg, db = jax.grad(loss, argnums=(0, 1))(jnp.asarray(1.0),
+                                            jnp.asarray(0.5))
+    assert dg.shape == () and db.shape == ()
+    # numerical check
+    eps = 1e-3
+    num = (loss(jnp.asarray(1.0 + eps), jnp.asarray(0.5))
+           - loss(jnp.asarray(1.0 - eps), jnp.asarray(0.5))) / (2 * eps)
+    np.testing.assert_allclose(float(dg), float(num), rtol=1e-2)

@@ -419,3 +419,48 @@ def test_graph_tbptt_sequence_level_labels_raise():
                        labels=[np.eye(3)[rng.randint(0, 3, 4)]])
     with pytest.raises(ValueError):
         cg.fit(mds)
+
+
+def test_fit_scan_matches_sequential_fit():
+    """Graph fit_scan == N sequential fit() calls, bitwise on params."""
+    rng = np.random.RandomState(0)
+    batches = [MultiDataSet([np.float32(rng.randn(6, 4))],
+                            [np.float32(np.eye(3)[rng.randint(0, 3, 6)])])
+               for _ in range(4)]
+    def build():
+        g = (_builder().add_inputs("in")
+             .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+             .add_layer("out", OutputLayer(n_in=5, n_out=3), "d")
+             .set_outputs("out").build())
+        return ComputationGraph(g).init()
+    seq, scan = build(), build()
+    for b in batches:
+        seq.fit(b)
+    scores = scan.fit_scan(batches)
+    assert scores.shape == (4,)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(scan.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_scan_mask_presence_per_index():
+    """Mask presence is validated per input index across batches, not
+    against batch 0 as a template."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+    rng = np.random.RandomState(1)
+    def mds(with_mask):
+        m = np.ones((2, 5), np.float32) if with_mask else None
+        return MultiDataSet([np.float32(rng.randn(2, 5, 3))],
+                            [np.float32(rng.rand(2, 5, 2))],
+                            [m], [m])
+    g = (_builder().add_inputs("in")
+         .add_layer("l", GravesLSTM(n_in=3, n_out=4), "in")
+         .add_layer("out", RnnOutputLayer(n_in=4, n_out=2), "l")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    with pytest.raises(ValueError, match="Mixed mask presence"):
+        net.fit_scan([mds(True), mds(False)])
+    with pytest.raises(ValueError, match="Mixed mask presence"):
+        net.fit_scan([mds(False), mds(True)])
+    net.fit_scan([mds(True), mds(True)])     # consistent masks train fine
