@@ -1,12 +1,14 @@
-"""Clustering tier: k-means, vantage-point tree nearest neighbours.
+"""Clustering tier: k-means, vantage-point tree, k-d tree.
 
 Reference module: ``deeplearning4j-core/.../clustering/`` (kmeans/
-KMeansClustering.java, vptree/VPTree.java, plus the kdtree/quadtree/sptree
-family whose only consumer is Barnes-Hut t-SNE — replaced here by the
-exact on-device t-SNE gradient, see ``plot/tsne.py``).
+KMeansClustering.java, vptree/VPTree.java, kdtree/KDTree.java; the
+quadtree/sptree pair exists only to serve Barnes-Hut t-SNE — replaced
+here by the exact on-device t-SNE gradient, see ``plot/tsne.py``).
 """
 
+from .kdtree import KDNode, KDTree
 from .kmeans import Cluster, ClusterSet, KMeansClustering
 from .vptree import VPTree
 
-__all__ = ["KMeansClustering", "Cluster", "ClusterSet", "VPTree"]
+__all__ = ["KMeansClustering", "Cluster", "ClusterSet", "VPTree",
+           "KDTree", "KDNode"]

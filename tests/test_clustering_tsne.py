@@ -138,3 +138,109 @@ class TestTsne:
         lines = p.read_text().strip().split("\n")
         assert len(lines) == 30
         assert lines[0].count(",") == 2  # x, y, label
+
+
+# ----------------------------------------------------------------- KDTree
+
+class TestKDTree:
+    """Reference ``KDTreeTest``: insert/nn plus delete and radius knn,
+    cross-checked against brute force."""
+
+    def test_basic_nn(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(2)
+        tree.insert([-1.0, -1.0])
+        tree.insert([1.0, 1.0])
+        tree.insert([0.5, 0.5])
+        d, p = tree.nn([0.4, 0.6])
+        np.testing.assert_allclose(p, [0.5, 0.5])
+        assert d == pytest.approx(np.hypot(0.1, 0.1))
+        assert tree.size() == 3
+
+    def test_nn_matches_brute_force(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        rng = np.random.RandomState(0)
+        pts = rng.randn(200, 3)
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        for q in rng.randn(25, 3):
+            d, p = tree.nn(q)
+            dists = np.linalg.norm(pts - q, axis=1)
+            assert d == pytest.approx(dists.min())
+            np.testing.assert_allclose(p, pts[dists.argmin()])
+
+    def test_radius_knn_sorted_and_complete(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        rng = np.random.RandomState(1)
+        pts = rng.rand(150, 2)
+        tree = KDTree(2)
+        for p in pts:
+            tree.insert(p)
+        q, r = np.array([0.5, 0.5]), 0.25
+        got = tree.knn(q, r)
+        dists = sorted(d for d in np.linalg.norm(pts - q, axis=1) if d <= r)
+        assert [d for d, _ in got] == pytest.approx(dists)
+        assert all(np.linalg.norm(p - q) <= r for _, p in got)
+
+    def test_delete(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        rng = np.random.RandomState(2)
+        pts = rng.randn(60, 2)
+        tree = KDTree(2)
+        for p in pts:
+            tree.insert(p)
+        # delete half the points, in shuffled order
+        drop = rng.permutation(60)[:30]
+        for i in drop:
+            assert tree.delete(pts[i]) is True
+        assert tree.size() == 30
+        assert tree.delete([123.0, 456.0]) is False
+        keep = np.delete(pts, drop, axis=0)
+        # remaining tree answers exact-NN over the surviving points
+        for q in rng.randn(15, 2):
+            d, _ = tree.nn(q)
+            assert d == pytest.approx(
+                np.linalg.norm(keep - q, axis=1).min())
+
+    def test_dim_validation(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(3)
+        with pytest.raises(ValueError, match="dims"):
+            tree.insert([1.0, 2.0])
+        with pytest.raises(ValueError, match="positive"):
+            KDTree(0)
+
+    def test_degenerate_insert_order_no_recursion_error(self):
+        """Sorted inserts build an n-deep spine; queries must use explicit
+        stacks, not Python recursion."""
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(2)
+        pts = np.array([[float(i), 0.0] for i in range(3000)])
+        for p in pts:
+            tree.insert(p)
+        d, p = tree.nn([1500.2, 0.0])
+        assert d == pytest.approx(0.2)
+        assert len(tree.knn([10.0, 0.0], 2.5)) == 5
+        assert tree.delete([2999.0, 0.0]) is True
+        assert tree.size() == 2999
+
+    def test_heavy_delete_triggers_rebuild_and_stays_correct(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        rng = np.random.RandomState(5)
+        pts = rng.randn(300, 3)
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        drop = rng.permutation(300)[:260]      # force rebuild threshold
+        for i in drop:
+            assert tree.delete(pts[i])
+        assert tree.size() == 40
+        keep = np.delete(pts, drop, axis=0)
+        for q in rng.randn(20, 3):
+            d, _ = tree.nn(q)
+            assert d == pytest.approx(
+                np.linalg.norm(keep - q, axis=1).min())
+        # radius search also sees only live points
+        hits = tree.knn(pts[drop[0]], 1e-9)
+        assert hits == []
