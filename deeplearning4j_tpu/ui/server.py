@@ -183,6 +183,75 @@ refresh(); setInterval(refresh, 5000);
 """
 
 
+_FLOW_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Network Flow</title>
+<style>
+body { font-family: sans-serif; margin: 1.5em; background: #fafafa; }
+h1 { font-size: 1.3em; }
+.chart { background: #fff; border: 1px solid #ddd; }
+</style></head>
+<body>
+<h1>Network topology</h1>
+<svg id="flow" class="chart" width="860" height="600"></svg>
+<script>
+async function refresh() {
+  const sids = await (await fetch('/train/sessions')).json();
+  if (!sids.length) return;
+  const d = await (await fetch('/flow/data?sid='
+                               + sids[sids.length - 1])).json();
+  const svg = document.getElementById('flow');
+  svg.innerHTML = '';
+  const rows = {};
+  (d.nodes || []).forEach(n => (rows[n.depth] = rows[n.depth] || []).push(n));
+  const W = svg.width.baseVal.value, BH = 34, BW = 150;
+  const depths = Object.keys(rows).map(Number).sort((a, b) => a - b);
+  svg.setAttribute('height', Math.max(200, depths.length * 70 + 40));
+  const pos = {};
+  depths.forEach((dep, r) => {
+    const row = rows[dep];
+    row.forEach((n, i) => {
+      const x = (W - row.length * (BW + 20)) / 2 + i * (BW + 20) + 10;
+      const y = 20 + r * 70;
+      pos[n.name] = [x + BW / 2, y, y + BH];
+      const g = document.createElementNS('http://www.w3.org/2000/svg','g');
+      const rect = document.createElementNS(
+        'http://www.w3.org/2000/svg','rect');
+      rect.setAttribute('x', x); rect.setAttribute('y', y);
+      rect.setAttribute('width', BW); rect.setAttribute('height', BH);
+      rect.setAttribute('rx', 5);
+      rect.setAttribute('fill', n.kind === 'input' ? '#e3f2fd' : '#fff');
+      rect.setAttribute('stroke', '#1976d2');
+      g.appendChild(rect);
+      const t = document.createElementNS('http://www.w3.org/2000/svg','text');
+      t.setAttribute('x', x + BW / 2); t.setAttribute('y', y + 14);
+      t.setAttribute('text-anchor', 'middle');
+      t.setAttribute('font-size', '11');
+      t.textContent = n.name;                       // textContent: safe
+      g.appendChild(t);
+      const t2 = document.createElementNS(
+        'http://www.w3.org/2000/svg','text');
+      t2.setAttribute('x', x + BW / 2); t2.setAttribute('y', y + 28);
+      t2.setAttribute('text-anchor', 'middle');
+      t2.setAttribute('font-size', '10'); t2.setAttribute('fill', '#666');
+      t2.textContent = n.detail || '';
+      g.appendChild(t2);
+      svg.appendChild(g);
+    });
+  });
+  (d.edges || []).forEach(([a, b]) => {
+    if (!pos[a] || !pos[b]) return;
+    const ln = document.createElementNS('http://www.w3.org/2000/svg','line');
+    ln.setAttribute('x1', pos[a][0]); ln.setAttribute('y1', pos[a][2]);
+    ln.setAttribute('x2', pos[b][0]); ln.setAttribute('y2', pos[b][1]);
+    ln.setAttribute('stroke', '#999');
+    svg.appendChild(ln);
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPUUI/1.0"
 
@@ -217,6 +286,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.model_data(sid))
         elif path == "/train/system/data":
             self._json(ui.system_data(sid))
+        elif path == "/flow":
+            self._send(200, _FLOW_PAGE.encode(), "text/html")
+        elif path == "/flow/data":
+            self._json(ui.flow_data(sid))
         elif path == "/tsne":
             self._send(200, _TSNE_PAGE.encode(), "text/html")
         elif path == "/tsne/data":
@@ -345,6 +418,84 @@ class UIServer:
                                   "num_params", "hostname")}
                     break
         return data
+
+    def flow_data(self, sid: Optional[str]) -> dict:
+        """Network-topology graph for the flow page (reference
+        ``module/flow/FlowListenerModule.java`` — renders the model
+        structure).  Nodes/edges come from the ``model_config_json``
+        the StatsListener posts in its static-info record."""
+        conf = None
+        if sid is not None:
+            for wid in self.storage.list_worker_ids(sid, TYPE_ID):
+                static = self.storage.get_static_info(sid, TYPE_ID, wid)
+                if static and static.data.get("model_config_json"):
+                    try:
+                        conf = json.loads(static.data["model_config_json"])
+                    except (TypeError, ValueError):
+                        conf = None
+                    break
+        if not isinstance(conf, dict):
+            return {"nodes": [], "edges": []}
+
+        def layer_detail(layer: dict) -> str:
+            if not isinstance(layer, dict):
+                return ""
+            n_in, n_out = layer.get("n_in"), layer.get("n_out")
+            kind = layer.get("type", "?")
+            return f"{kind} {n_in or '?'}->{n_out or '?'}"
+
+        nodes, edges = [], []
+        # the config arrives via the unauthenticated /remote path, so a
+        # malformed document must yield an empty graph, not a crashed
+        # handler thread
+        try:
+            if conf.get("type") == "computation_graph_conf":
+                net_inputs = [n for n in conf.get("network_inputs") or []
+                              if isinstance(n, str)]
+                for name in net_inputs:
+                    nodes.append({"name": name, "kind": "input",
+                                  "depth": 0, "detail": "input"})
+                raw = conf.get("vertices")
+                vertices = {k: v for k, v in raw.items()
+                            if isinstance(v, dict)} \
+                    if isinstance(raw, dict) else {}
+                depth_of = {n: 0 for n in net_inputs}
+
+                def depth(name, seen=()):
+                    if name in depth_of:
+                        return depth_of[name]
+                    if name in seen or name not in vertices:
+                        return 0
+                    ins = vertices[name].get("inputs") or []
+                    d = 1 + max((depth(i, seen + (name,)) for i in ins),
+                                default=0)
+                    depth_of[name] = d
+                    return d
+
+                for name, v in vertices.items():
+                    layer = v.get("layer")
+                    detail = (layer_detail(layer) if layer
+                              else str(v.get("type", "vertex")))
+                    nodes.append({"name": name, "kind": "vertex",
+                                  "depth": depth(name), "detail": detail})
+                    for src in v.get("inputs") or []:
+                        edges.append([src, name])
+            else:
+                layers = [l for l in conf.get("layers") or []]
+                nodes.append({"name": "input", "kind": "input", "depth": 0,
+                              "detail": "input"})
+                prev = "input"
+                for i, layer in enumerate(layers):
+                    name = f"{i}_{layer.get('type', 'layer')}" \
+                        if isinstance(layer, dict) else str(i)
+                    nodes.append({"name": name, "kind": "layer",
+                                  "depth": i + 1,
+                                  "detail": layer_detail(layer)})
+                    edges.append([prev, name])
+                    prev = name
+        except Exception:
+            return {"nodes": [], "edges": []}
+        return {"nodes": nodes, "edges": edges}
 
     def system_data(self, sid: Optional[str]) -> dict:
         """System tab (reference ``TrainModule`` system tab: per-worker

@@ -194,3 +194,87 @@ def test_remote_router_posts_into_server_storage():
         assert len(ov["score_vs_iter"]) == 2
     finally:
         server.stop()
+
+
+def test_flow_endpoint_renders_topology():
+    """Reference flow module: /flow serves the topology page and
+    /flow/data derives nodes+edges from the posted model config."""
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0).start()
+    try:
+        listener = StatsListener(storage, update_frequency=1)
+        net = _net()
+        net.add_listener(listener)
+        net.fit(_data(), epochs=1)
+        base = f"http://127.0.0.1:{server.port}"
+        page = urllib.request.urlopen(base + "/flow").read()
+        assert b"Network topology" in page
+        fd = json.loads(urllib.request.urlopen(
+            base + f"/flow/data?sid={listener.session_id}").read())
+        names = [n["name"] for n in fd["nodes"]]
+        assert names[0] == "input"
+        assert len(fd["nodes"]) == 1 + len(net.layers)
+        assert len(fd["edges"]) == len(net.layers)
+        # chain depths strictly increase
+        assert [n["depth"] for n in fd["nodes"]] == list(
+            range(len(fd["nodes"])))
+        # detail strings carry layer type and width
+        assert any("dense" in n["detail"] for n in fd["nodes"])
+    finally:
+        server.stop()
+
+
+def test_flow_data_graph_conf():
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.computation_graph import MergeVertex
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+    g = (NeuralNetConfiguration.builder().seed(0).graph_builder()
+         .add_inputs("in1", "in2")
+         .add_layer("d1", DenseLayer(n_in=2, n_out=4), "in1")
+         .add_layer("d2", DenseLayer(n_in=3, n_out=4), "in2")
+         .add_vertex("m", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_in=8, n_out=2), "m")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0).start()
+    try:
+        listener = StatsListener(storage, update_frequency=1)
+        net.add_listener(listener)
+        rng = np.random.RandomState(0)
+        net.fit(MultiDataSet(
+            [np.float32(rng.randn(4, 2)), np.float32(rng.randn(4, 3))],
+            [np.float32(np.eye(2)[rng.randint(0, 2, 4)])]))
+        fd = server.flow_data(listener.session_id)
+        byname = {n["name"]: n for n in fd["nodes"]}
+        assert byname["in1"]["depth"] == 0 and byname["in2"]["depth"] == 0
+        assert byname["m"]["depth"] == 2 and byname["out"]["depth"] == 3
+        assert ["d1", "m"] in fd["edges"] and ["d2", "m"] in fd["edges"]
+        assert "dense" in byname["d1"]["detail"]
+    finally:
+        server.stop()
+
+
+def test_flow_data_survives_malformed_remote_config():
+    """A hostile/garbled model_config_json posted via /remote must yield
+    an empty graph, not a crashed handler."""
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0).start()
+    try:
+        for bad in ('{"type": "computation_graph_conf", '
+                    '"vertices": {"a": "oops"}}',
+                    '{"type": "computation_graph_conf", "vertices": [1]}',
+                    '{"layers": ["zz", 5]}',
+                    "not json at all"):
+            storage.put_static_info(Persistable(
+                "evil", TYPE_ID, "w0", 1.0, {"model_config_json": bad}))
+            fd = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/flow/data?sid=evil"
+            ).read())
+            assert isinstance(fd["nodes"], list)
+    finally:
+        server.stop()
