@@ -186,7 +186,11 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------ loss
     def _loss_fn(self, params, net_state, features, labels, features_masks,
-                 labels_masks, rng, train: bool, carries=None):
+                 labels_masks, rng, train: bool, carries=None,
+                 per_example: bool = False):
+        """``per_example`` accumulates the unreduced (batch,) score vector
+        across output layers (reference ``computeScoreForExamples``)
+        instead of the scalar batch loss."""
         input_masks = None
         if features_masks is not None:
             input_masks = {n: m for n, m in zip(self.conf.network_inputs,
@@ -196,7 +200,8 @@ class ComputationGraph:
             params, net_state, features, train=train, rng=rng,
             input_masks=input_masks, preoutput_outputs=True,
             carries=carries)
-        total = jnp.asarray(0.0, jnp.float32)
+        total = (jnp.zeros((features[0].shape[0],), jnp.float32)
+                 if per_example else jnp.asarray(0.0, jnp.float32))
         for i, out_name in enumerate(self.conf.network_outputs):
             v = self.vertices[out_name]
             layer = v.layer
@@ -210,16 +215,24 @@ class ComputationGraph:
                 if layer.dropout and train and rng is not None:
                     x = layer.apply_dropout(
                         x, train, jax.random.fold_in(rng, 100_000 + i))
-                total = total + layer.compute_score_with_input(
-                    params[out_name], labels[i], x, lmask,
-                    average=self.conf.conf.mini_batch)
+                if per_example:
+                    total = total + layer.compute_score_examples_with_input(
+                        params[out_name], labels[i], x, lmask)
+                else:
+                    total = total + layer.compute_score_with_input(
+                        params[out_name], labels[i], x, lmask,
+                        average=self.conf.conf.mini_batch)
                 continue
             if not hasattr(layer, "compute_score"):
                 raise ValueError(
                     f"Output vertex '{out_name}' is not an output layer")
-            total = total + layer.compute_score(
-                labels[i], acts[out_name], lmask,
-                average=self.conf.conf.mini_batch)
+            if per_example:
+                total = total + layer.compute_score_examples(
+                    labels[i], acts[out_name], lmask)
+            else:
+                total = total + layer.compute_score(
+                    labels[i], acts[out_name], lmask,
+                    average=self.conf.conf.mini_batch)
         return total, (new_state, new_carries)
 
     def _reg_score(self, params) -> Array:
@@ -424,6 +437,46 @@ class ComputationGraph:
                 labels_masks, None, False)
             return data_loss + self._reg_score(params)
         return jax.jit(score)
+
+    @functools.cached_property
+    def _score_examples_fn(self):
+        @functools.partial(jax.jit, static_argnums=(6,))
+        def run(params, net_state, features, labels, features_masks,
+                labels_masks, add_reg):
+            per, _ = self._loss_fn(params, net_state, features, labels,
+                                   features_masks, labels_masks, None,
+                                   False, per_example=True)
+            if add_reg:
+                per = per + self._reg_score(params)
+            return per
+        return run
+
+    def score_examples(self, data,
+                       add_regularization_terms: bool = True) -> np.ndarray:
+        """Per-example loss vector, summed over output layers, no batch
+        averaging (reference ``ComputationGraph.scoreExamples:1486-1520``).
+        ``data`` is a DataSet/MultiDataSet or an iterator of either,
+        streamed batch by batch."""
+        self.init()
+        batches = ([data] if isinstance(data, (DataSet, MultiDataSet))
+                   else iter(data))
+        out = []
+        for b in batches:
+            mds = _as_multi(b)
+            feats = tuple(jnp.asarray(f) for f in mds.features)
+            labels = tuple(jnp.asarray(l) for l in mds.labels)
+            fmasks = (None if mds.features_masks is None else tuple(
+                None if m is None else jnp.asarray(m)
+                for m in mds.features_masks))
+            lmasks = (None if mds.labels_masks is None else tuple(
+                None if m is None else jnp.asarray(m)
+                for m in mds.labels_masks))
+            out.append(np.asarray(self._score_examples_fn(
+                self.params, self.net_state, feats, labels, fmasks,
+                lmasks, bool(add_regularization_terms))))
+        if not out:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(out)
 
     # -------------------------------------------------------------- pretrain
     def _pretrain_step(self, name: str):

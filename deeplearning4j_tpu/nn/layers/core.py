@@ -66,6 +66,13 @@ class OutputLayer(FeedForwardLayerConfig):
         return _losses.score(self.loss, labels, preout, self.activation,
                              mask, average)
 
+    def compute_score_examples(self, labels: Array, preout: Array,
+                               mask: Optional[Array] = None) -> Array:
+        """Per-example scores (reference
+        ``BaseOutputLayer.computeScoreForExamples``)."""
+        return _losses.score_examples(self.loss, labels, preout,
+                                      self.activation, mask)
+
 
 @serde.register("loss")
 @dataclasses.dataclass
@@ -93,6 +100,13 @@ class LossLayer(BaseLayerConfig):
                       average: bool = True) -> Array:
         return _losses.score(self.loss, labels, preout, self.activation,
                              mask, average)
+
+    def compute_score_examples(self, labels: Array, preout: Array,
+                               mask: Optional[Array] = None) -> Array:
+        """Per-example scores (reference
+        ``BaseOutputLayer.computeScoreForExamples``)."""
+        return _losses.score_examples(self.loss, labels, preout,
+                                      self.activation, mask)
 
 
 @serde.register("activation")

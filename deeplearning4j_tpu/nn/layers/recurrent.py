@@ -311,3 +311,10 @@ class RnnOutputLayer(BaseRecurrentLayer):
                       average: bool = True) -> Array:
         return _losses.score(self.loss, labels, preout, self.activation,
                              mask, average)
+
+    def compute_score_examples(self, labels: Array, preout: Array,
+                               mask: Optional[Array] = None) -> Array:
+        """Per-example scores (reference
+        ``BaseOutputLayer.computeScoreForExamples``)."""
+        return _losses.score_examples(self.loss, labels, preout,
+                                      self.activation, mask)

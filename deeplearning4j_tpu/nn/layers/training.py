@@ -122,3 +122,24 @@ class CenterLossOutputLayer(FeedForwardLayerConfig):
             w * jnp.sum((jax.lax.stop_gradient(x) - assigned) ** 2, axis=-1))
         return (supervised + total_center
                 + carrier - jax.lax.stop_gradient(carrier))
+
+    def compute_score_examples_with_input(self, params: ParamTree,
+                                          labels: Array, x: Array,
+                                          mask: Optional[Array] = None
+                                          ) -> Array:
+        """Per-example scores: supervised loss + lambda/2 ||x - c_y||^2
+        per example (reference ``CenterLossOutputLayer
+        .computeScoreForExamples``)."""
+        preout = self.pre_output(params, x)
+        supervised = _losses.score_examples(self.loss, labels, preout,
+                                            self.activation, mask)
+        centers = params["cL"].astype(x.dtype)
+        lab = labels.astype(x.dtype)
+        if mask is not None:
+            lab = lab * mask.reshape(lab.shape[0], *([1] * (lab.ndim - 1)))
+        assigned = lab @ centers
+        center_term = 0.5 * self.lambda_ * jnp.sum(
+            (x - assigned) ** 2, axis=-1)
+        if mask is not None:
+            center_term = center_term * mask.reshape(center_term.shape)
+        return supervised + center_term

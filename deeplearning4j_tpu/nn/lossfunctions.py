@@ -228,5 +228,15 @@ def score(name: str, labels: Array, preout: Array, activation: str,
     return total / per.shape[0]
 
 
+def score_examples(name: str, labels: Array, preout: Array,
+                   activation: str,
+                   mask: Optional[Array] = None) -> Array:
+    """Per-example scores, shape (batch,) — no averaging/summing over the
+    batch (reference ``ILossFunction.computeScoreArray``, consumed by
+    ``MultiLayerNetwork.scoreExamples:1757``).  Time-series losses sum
+    over unmasked steps per example."""
+    return get(name)(labels, preout, activation, mask)
+
+
 def available() -> list[str]:
     return sorted(_LOSSES)

@@ -151,12 +151,14 @@ class MultiLayerNetwork:
     # ----------------------------------------------------------------- loss
     def _loss_fn(self, params, net_state, features, labels, features_mask,
                  labels_mask, rng, train: bool, carries=None,
-                 from_layer: int = 0):
+                 from_layer: int = 0, per_example: bool = False):
         """Data loss (+ new state, new carries).  Regularization is handled
         updater-side to match the reference order of operations (SURVEY.md §7
         hard part d); the reported score adds the reg term separately
         (``BaseLayer.calcL2``).  ``from_layer`` scores a mid-stack
-        activation through the remaining layers (exact-tBPTT suffix)."""
+        activation through the remaining layers (exact-tBPTT suffix).
+        ``per_example`` returns the unreduced (batch,) score vector
+        (reference ``computeScoreForExamples``)."""
         out_layer = self.layers[-1]
         if getattr(out_layer, "NEEDS_INPUT_FOR_SCORE", False):
             # Center-loss-style heads score against the layer *input* (the
@@ -172,9 +174,13 @@ class MultiLayerNetwork:
                 x = out_layer.apply_dropout(
                     x, train, jax.random.fold_in(rng, n - 1)
                     if rng is not None else None)
-            data_loss = out_layer.compute_score_with_input(
-                params[n - 1], labels, x, labels_mask,
-                average=self.conf.conf.mini_batch)
+            if per_example:
+                data_loss = out_layer.compute_score_examples_with_input(
+                    params[n - 1], labels, x, labels_mask)
+            else:
+                data_loss = out_layer.compute_score_with_input(
+                    params[n - 1], labels, x, labels_mask,
+                    average=self.conf.conf.mini_batch)
             return data_loss, (new_state, new_carries)
         preout, new_state, new_carries = self._forward(
             params, net_state, features, train=train, rng=rng,
@@ -188,6 +194,10 @@ class MultiLayerNetwork:
             # Per-timestep output: the features mask doubles as the labels
             # mask (reference feedForwardMaskArray propagation).
             lmask = features_mask
+        if per_example:
+            data_loss = out_layer.compute_score_examples(labels, preout,
+                                                         lmask)
+            return data_loss, (new_state, new_carries)
         data_loss = out_layer.compute_score(labels, preout, lmask,
                                             average=self.conf.conf.mini_batch)
         return data_loss, (new_state, new_carries)
@@ -730,6 +740,42 @@ class MultiLayerNetwork:
                              jnp.asarray(dataset.features),
                              jnp.asarray(dataset.labels), fmask, lmask)
         return float(val)
+
+    @functools.cached_property
+    def _score_examples_fn(self):
+        @functools.partial(jax.jit, static_argnums=(6,))
+        def run(params, net_state, features, labels, features_mask,
+                labels_mask, add_reg):
+            per, _ = self._loss_fn(params, net_state, features, labels,
+                                   features_mask, labels_mask, None, False,
+                                   per_example=True)
+            if add_reg:
+                per = per + self._reg_score(params)
+            return per
+        return run
+
+    def score_examples(self, data,
+                       add_regularization_terms: bool = True) -> np.ndarray:
+        """Per-example loss vector, no batch averaging (reference
+        ``scoreExamples:1740-1775``) — e.g. autoencoder anomaly scoring.
+        ``data`` is a DataSet or an iterator (streamed batch by batch);
+        with regularization, each entry equals ``score()`` on that single
+        example."""
+        self.init()
+        batches = [data] if isinstance(data, DataSet) else iter(data)
+        out = []
+        for ds in batches:
+            fmask = (None if ds.features_mask is None
+                     else jnp.asarray(ds.features_mask))
+            lmask = (None if ds.labels_mask is None
+                     else jnp.asarray(ds.labels_mask))
+            out.append(np.asarray(self._score_examples_fn(
+                self.params, self.net_state, jnp.asarray(ds.features),
+                jnp.asarray(ds.labels), fmask, lmask,
+                bool(add_regularization_terms))))
+        if not out:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(out)
 
     def evaluate(self, iterator):
         """Classification evaluation over an iterator (reference

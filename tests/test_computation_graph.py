@@ -464,3 +464,56 @@ def test_fit_scan_mask_presence_per_index():
     with pytest.raises(ValueError, match="Mixed mask presence"):
         net.fit_scan([mds(False), mds(True)])
     net.fit_scan([mds(True), mds(True)])     # consistent masks train fine
+
+
+def test_graph_score_examples_matches_single_example_score():
+    """Reference ComputationGraph.scoreExamples: per-example scores sum
+    output-layer losses; with reg each row equals score() on one example."""
+    g = (_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+         .add_layer("out", OutputLayer(n_in=5, n_out=3), "d")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    X = np.float64(rng.randn(6, 4))
+    Y = np.float64(np.eye(3)[rng.randint(0, 3, 6)])
+    per = net.score_examples(MultiDataSet([X], [Y]))
+    assert per.shape == (6,)
+    for i in range(3):
+        single = net.score(MultiDataSet([X[i:i + 1]], [Y[i:i + 1]]))
+        assert per[i] == pytest.approx(single, rel=1e-5)
+
+
+def test_graph_score_examples_sums_multiple_outputs():
+    g = (_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+         .add_layer("o1", OutputLayer(n_in=5, n_out=3), "d")
+         .add_layer("o2", OutputLayer(n_in=5, n_out=2, loss="mse",
+                                      activation="identity"), "d")
+         .set_outputs("o1", "o2").build())
+    net = ComputationGraph(g).init()
+    rng = np.random.RandomState(1)
+    X = np.float64(rng.randn(5, 4))
+    Y1 = np.float64(np.eye(3)[rng.randint(0, 3, 5)])
+    Y2 = np.float64(rng.randn(5, 2))
+    both = net.score_examples(MultiDataSet([X], [Y1, Y2]),
+                              add_regularization_terms=False)
+    # equals the sum of single-output nets' per-example data losses
+    g1 = (_builder().add_inputs("in")
+          .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+          .add_layer("o1", OutputLayer(n_in=5, n_out=3), "d")
+          .set_outputs("o1").build())
+    n1 = ComputationGraph(g1).init()
+    n1.params["d"], n1.params["o1"] = net.params["d"], net.params["o1"]
+    g2 = (_builder().add_inputs("in")
+          .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+          .add_layer("o2", OutputLayer(n_in=5, n_out=2, loss="mse",
+                                       activation="identity"), "d")
+          .set_outputs("o2").build())
+    n2 = ComputationGraph(g2).init()
+    n2.params["d"], n2.params["o2"] = net.params["d"], net.params["o2"]
+    s1 = n1.score_examples(MultiDataSet([X], [Y1]),
+                           add_regularization_terms=False)
+    s2 = n2.score_examples(MultiDataSet([X], [Y2]),
+                           add_regularization_terms=False)
+    np.testing.assert_allclose(both, s1 + s2, rtol=1e-6)

@@ -223,3 +223,62 @@ def test_fit_scan_matches_sequential_steps():
     assert net_a.iteration == net_b.iteration == 4
     assert scores.shape == (4,)
     assert np.all(np.isfinite(scores))
+
+
+# ------------------------------------------------------------ scoreExamples
+
+def test_score_examples_matches_single_example_score():
+    """Reference contract (scoreExamples:1757): with regularization, the
+    ith entry equals score() on a DataSet holding only example i."""
+    conf = (NeuralNetConfiguration.builder().seed(3).updater("sgd")
+            .learning_rate(0.1).l2(0.01).weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_in=6, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    X = np.float32(rng.randn(7, 4))
+    Y = np.float32(np.eye(3)[rng.randint(0, 3, 7)])
+    per = net.score_examples(DataSet(X, Y), add_regularization_terms=True)
+    assert per.shape == (7,)
+    for i in range(7):
+        single = net.score(DataSet(X[i:i + 1], Y[i:i + 1]))
+        assert per[i] == pytest.approx(single, rel=1e-5)
+    # without reg: mean equals unregularized data loss
+    plain = net.score_examples(DataSet(X, Y), add_regularization_terms=False)
+    assert (per - plain).std() == pytest.approx(0.0, abs=1e-6)
+    assert per[0] - plain[0] > 0          # l2 term present
+
+
+def test_score_examples_iterator_and_autoencoder_anomaly():
+    """The reference use case: per-example reconstruction error ranks an
+    outlier last (autoencoder anomaly detection)."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam")
+            .learning_rate(1e-2).weight_init("xavier").list()
+            .layer(DenseLayer(n_in=8, n_out=3, activation="tanh"))
+            .layer(OutputLayer(n_in=3, n_out=8, activation="identity",
+                               loss="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    X = np.float32(rng.randn(64, 8) * 0.1)
+    net.fit(DataSet(X, X), epochs=200)
+    probe = np.concatenate([X[:16], np.float32(np.ones((1, 8)) * 3.0)])
+    scores = net.score_examples(DataSet(probe, probe),
+                                add_regularization_terms=False)
+    assert scores.argmax() == 16          # the outlier scores worst
+    # iterator overload concatenates across batches
+    it = ListDataSetIterator(DataSet(probe, probe), batch_size=5)
+    np.testing.assert_allclose(net.score_examples(it), scores, rtol=1e-5)
+
+
+def test_score_examples_empty_iterator():
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_in=4, n_out=6))
+            .layer(OutputLayer(n_in=6, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.score_examples(iter([]))
+    assert out.shape == (0,)
