@@ -25,6 +25,20 @@ class Prediction:
     record_meta_data: object
 
 
+def flatten_time_series(labels, predictions, mask=None):
+    """(batch, time, C) arrays → (kept_steps, C), dropping masked steps
+    (the shared ``BaseEvaluation.evalTimeSeries`` reshape used by
+    Evaluation, ROC and RegressionEvaluation)."""
+    labels = np.asarray(labels)
+    predictions = np.asarray(predictions)
+    L = labels.reshape(-1, labels.shape[-1])
+    P = predictions.reshape(-1, predictions.shape[-1])
+    if mask is not None:
+        keep = np.asarray(mask).reshape(-1) > 0
+        L, P = L[keep], P[keep]
+    return L, P
+
+
 class ConfusionMatrix:
     """Counts actual x predicted (reference ``eval/ConfusionMatrix.java``)."""
 
@@ -83,11 +97,8 @@ class Evaluation:
                     "record_meta_data applies to (batch, classes) "
                     "evaluation, not time series")
             # RNN (batch, time, classes) -> flatten time-major
-            labels = labels.reshape(-1, labels.shape[-1])
-            predictions = predictions.reshape(-1, predictions.shape[-1])
-            if mask is not None:
-                keep = np.asarray(mask).reshape(-1) > 0
-                labels, predictions = labels[keep], predictions[keep]
+            labels, predictions = flatten_time_series(labels, predictions,
+                                                      mask)
         # validate before any accumulation: a raised batch must leave the
         # counters untouched so the caller can retry it
         if record_meta_data is not None \

@@ -30,13 +30,8 @@ class RegressionEvaluation:
         y = np.asarray(labels, np.float64)
         p = np.asarray(predictions, np.float64)
         if y.ndim == 3:
-            if mask is not None:
-                m = np.asarray(mask).astype(bool).reshape(-1)
-                y = y.reshape(-1, y.shape[-1])[m]
-                p = p.reshape(-1, p.shape[-1])[m]
-            else:
-                y = y.reshape(-1, y.shape[-1])
-                p = p.reshape(-1, p.shape[-1])
+            from .evaluation import flatten_time_series
+            y, p = flatten_time_series(y, p, mask)
         if y.ndim == 1:
             y = y[:, None]
             p = p[:, None]
@@ -54,6 +49,11 @@ class RegressionEvaluation:
         self._sum_p += np.sum(p, axis=0)
         self._sum_p2 += np.sum(p * p, axis=0)
         self._sum_yp += np.sum(y * p, axis=0)
+
+    def eval_time_series(self, labels, predictions, mask=None) -> None:
+        """Alias: ``eval`` already flattens (batch, time, cols) with the
+        mask (reference ``BaseEvaluation.evalTimeSeries``)."""
+        self.eval(labels, predictions, mask)
 
     def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
         """Fold another evaluation's sums into this one (reference

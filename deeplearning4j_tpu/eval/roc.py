@@ -37,6 +37,12 @@ class ROC:
         if labels.ndim == 2 and labels.shape[1] == 2:
             y = labels[:, 1]
             p = predictions[:, 1]
+        elif labels.ndim == 2 and labels.shape[1] > 2:
+            # the reference ROC throws for >2 label columns; silently
+            # flattening a multi-class one-hot would fabricate an AUC
+            raise ValueError(
+                f"ROC is binary; got {labels.shape[1]} label columns — "
+                f"use ROCMultiClass")
         else:
             y = labels.reshape(-1)
             p = predictions.reshape(-1)
@@ -51,6 +57,12 @@ class ROC:
             self.fp[i] += int(np.sum(pred_pos & ~pos))
             self.fn[i] += int(np.sum(~pred_pos & pos))
             self.tn[i] += int(np.sum(~pred_pos & ~pos))
+
+    def eval_time_series(self, labels, predictions, mask=None) -> None:
+        """(batch, time, classes) evaluation with per-step masking
+        (reference ``BaseEvaluation.evalTimeSeries``)."""
+        from .evaluation import flatten_time_series
+        self.eval(*flatten_time_series(labels, predictions, mask))
 
     def merge(self, other: "ROC") -> "ROC":
         """Fold another ROC's threshold counts into this one (reference
@@ -111,6 +123,8 @@ class ROCMultiClass:
         for c in range(n_classes):
             roc = self.per_class.setdefault(c, ROC(self.threshold_steps))
             roc.eval(labels[:, c], predictions[:, c])
+
+    eval_time_series = ROC.eval_time_series
 
     def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
         """Fold per-class counts (reference ``IEvaluation.merge``)."""

@@ -795,13 +795,13 @@ class ComputationGraph:
             tuple(jnp.asarray(f) for f in mds.features),
             tuple(jnp.asarray(l) for l in mds.labels), fmasks, lmasks))
 
-    def evaluate(self, iterator):
-        """Single-output classification evaluation (reference
-        ``SparkComputationGraph``-style ``evaluate``)."""
-        from ..eval.evaluation import Evaluation
+    def do_evaluation(self, iterator, *evaluators):
+        """Run one forward pass per batch, feeding every evaluator
+        (reference ``doEvaluation``); single-output graphs only.  Returns
+        the evaluators."""
         if len(self.conf.network_outputs) != 1:
-            raise ValueError("evaluate() requires a single-output graph")
-        ev = Evaluation()
+            raise ValueError("do_evaluation() requires a single-output "
+                             "graph")
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         if hasattr(iterator, "reset"):
@@ -811,17 +811,42 @@ class ComputationGraph:
             out = self.output(*mds.features,
                               features_masks=mds.features_masks)
             labels = np.asarray(mds.labels[0])
-            if out.ndim == 3:
-                mask = None
-                if mds.labels_masks is not None:
-                    mask = mds.labels_masks[0]
-                elif mds.features_masks is not None:
-                    mask = mds.features_masks[0]
-                ev.eval_time_series(
-                    labels, out, None if mask is None else np.asarray(mask))
-            else:
-                ev.eval(labels, out)
-        return ev
+            mask = None
+            if mds.labels_masks is not None:
+                mask = mds.labels_masks[0]
+            elif mds.features_masks is not None:
+                mask = mds.features_masks[0]
+            mask = None if mask is None else np.asarray(mask)
+            for ev in evaluators:
+                if out.ndim == 3:
+                    ev.eval_time_series(labels, out, mask)
+                else:
+                    ev.eval(labels, out)
+        return evaluators
+
+    def evaluate(self, iterator):
+        """Single-output classification evaluation (reference
+        ``SparkComputationGraph``-style ``evaluate``)."""
+        from ..eval.evaluation import Evaluation
+        return self.do_evaluation(iterator, Evaluation())[0]
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        """Binary ROC (reference ``evaluateROC``)."""
+        from ..eval.roc import ROC
+        return self.do_evaluation(iterator, ROC(threshold_steps))[0]
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 30):
+        """One-vs-all ROC (reference ``evaluateROCMultiClass``)."""
+        from ..eval.roc import ROCMultiClass
+        return self.do_evaluation(iterator,
+                                  ROCMultiClass(threshold_steps))[0]
+
+    def evaluate_regression(self, iterator):
+        """Per-column regression stats (reference
+        ``evaluateRegression``)."""
+        from ..eval.regression import RegressionEvaluation
+        return self.do_evaluation(iterator, RegressionEvaluation())[0]
 
     def predict(self, *features) -> np.ndarray:
         out = self.output(*features)

@@ -777,12 +777,11 @@ class MultiLayerNetwork:
             return np.zeros((0,), np.float32)
         return np.concatenate(out)
 
-    def evaluate(self, iterator):
-        """Classification evaluation over an iterator (reference
-        ``MultiLayerNetwork.evaluate``; time-series outputs go through the
-        masked ``evalTimeSeries`` path)."""
-        from ..eval.evaluation import Evaluation
-        ev = Evaluation()
+    def do_evaluation(self, iterator, *evaluators):
+        """Run one forward pass per batch, feeding every evaluator
+        (reference ``doEvaluation(iterator, IEvaluation...)``) —
+        time-series outputs go through the masked ``evalTimeSeries``
+        path.  Returns the evaluators."""
         if isinstance(iterator, DataSet):
             iterator = [iterator]
         if hasattr(iterator, "reset"):
@@ -790,14 +789,43 @@ class MultiLayerNetwork:
         for ds in iterator:
             out = self.output(ds.features, features_mask=ds.features_mask)
             labels = np.asarray(ds.labels)
-            if out.ndim == 3:
-                mask = (ds.labels_mask if ds.labels_mask is not None
-                        else ds.features_mask)
-                ev.eval_time_series(labels, out,
-                                    None if mask is None else np.asarray(mask))
-            else:
-                ev.eval(labels, out)
-        return ev
+            mask = (ds.labels_mask if ds.labels_mask is not None
+                    else ds.features_mask)
+            mask = None if mask is None else np.asarray(mask)
+            for ev in evaluators:
+                if out.ndim == 3:
+                    ev.eval_time_series(labels, out, mask)
+                else:
+                    ev.eval(labels, out)
+        return evaluators
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (reference
+        ``MultiLayerNetwork.evaluate``)."""
+        from ..eval.evaluation import Evaluation
+        return self.do_evaluation(iterator, Evaluation())[0]
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        """Binary ROC over an iterator (reference ``evaluateROC``)."""
+        from ..eval.roc import ROC
+        return self.do_evaluation(iterator, ROC(threshold_steps))[0]
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 30):
+        """One-vs-all ROC (reference ``evaluateROCMultiClass``)."""
+        from ..eval.roc import ROCMultiClass
+        return self.do_evaluation(iterator,
+                                  ROCMultiClass(threshold_steps))[0]
+
+    def evaluate_regression(self, iterator):
+        """Per-column regression stats (reference
+        ``evaluateRegression``)."""
+        from ..eval.regression import RegressionEvaluation
+        return self.do_evaluation(iterator, RegressionEvaluation())[0]
+
+    def f1_score(self, data) -> float:
+        """Macro F1 on a DataSet/iterator (reference ``f1Score``)."""
+        return self.evaluate(data).f1()
 
     # ------------------------------------------------ flat-param invariant
     def param_table(self) -> Dict[str, np.ndarray]:

@@ -258,3 +258,78 @@ def test_merge_top_n_mismatch_raises():
     b.eval(labels, labels)
     with pytest.raises(ValueError, match="top_n"):
         a.merge(b)
+
+
+# ------------------------------- network doEvaluation + evaluator variants
+
+def test_do_evaluation_multiple_evaluators_one_pass():
+    from deeplearning4j_tpu import DataSet, MultiLayerNetwork, \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.eval.roc import ROC
+
+    conf = (NeuralNetConfiguration.builder().seed(2).learning_rate(0.3)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    X = np.float32(rng.randn(200, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    Y = np.float32(np.eye(2)[y])
+    net.fit(DataSet(X, Y), epochs=60)
+
+    ev, roc = net.do_evaluation(DataSet(X, Y), Evaluation(), ROC())
+    assert ev.accuracy() > 0.8
+    assert roc.calculate_auc() > 0.85
+    # conveniences agree with the underlying evaluators
+    assert net.evaluate_roc(DataSet(X, Y)).calculate_auc() == \
+        pytest.approx(roc.calculate_auc())
+    assert net.evaluate_roc_multi_class(DataSet(X, Y)) \
+        .calculate_average_auc() > 0.8
+    assert net.f1_score(DataSet(X, Y)) == pytest.approx(ev.f1())
+
+
+def test_evaluate_regression_convenience():
+    from deeplearning4j_tpu import DataSet, MultiLayerNetwork, \
+        NeuralNetConfiguration
+
+    conf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.05)
+            .updater("adam").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=3, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=1, activation="identity",
+                               loss="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(1)
+    X = np.float32(rng.randn(256, 3))
+    Y = np.float32((X.sum(axis=1, keepdims=True)) * 0.5)
+    net.fit(DataSet(X, Y), epochs=300)
+    reg = net.evaluate_regression(DataSet(X, Y))
+    assert reg.r_squared(0) > 0.9
+    assert reg.mean_squared_error(0) < 0.1
+
+
+def test_roc_eval_time_series_masks():
+    from deeplearning4j_tpu.eval.roc import ROC
+    roc_masked = ROC()
+    labels = np.zeros((2, 3, 2)); preds = np.zeros((2, 3, 2))
+    labels[0, 0] = [0, 1]; preds[0, 0] = [0.1, 0.9]    # kept, correct
+    labels[0, 1] = [1, 0]; preds[0, 1] = [0.2, 0.8]    # kept, wrong-ish
+    labels[0, 2] = [0, 1]; preds[0, 2] = [0.9, 0.1]    # MASKED OUT
+    labels[1, :2] = [[1, 0], [0, 1]]; preds[1, :2] = [[0.7, 0.3], [0.4, 0.6]]
+    mask = np.array([[1, 1, 0], [1, 1, 0]], np.float32)
+    roc_masked.eval_time_series(labels, preds, mask)
+    roc_flat = ROC()
+    keep = mask.reshape(-1) > 0
+    roc_flat.eval(labels.reshape(-1, 2)[keep], preds.reshape(-1, 2)[keep])
+    assert roc_masked.calculate_auc() == pytest.approx(
+        roc_flat.calculate_auc())
+
+
+def test_roc_rejects_multiclass_labels():
+    from deeplearning4j_tpu.eval.roc import ROC
+    with pytest.raises(ValueError, match="ROCMultiClass"):
+        ROC().eval(np.eye(3)[[0, 1, 2]], np.eye(3)[[0, 1, 2]])
