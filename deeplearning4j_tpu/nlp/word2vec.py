@@ -481,13 +481,42 @@ class SequenceVectors:
             return 0.0
         return float(np.dot(a, b) / (na * nb))
 
-    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
-        """Nearest neighbors by cosine (reference ``wordsNearest``)."""
+    def words_nearest(self, word_or_vec, negative=None,
+                      top_n: int = 10) -> List[str]:
+        """Nearest neighbors by cosine (reference ``wordsNearest``).
+
+        Also accepts the analogy form (reference
+        ``wordsNearest(positive, negative, top)`` /
+        ``wordsNearestSum``): a list of positive words plus an optional
+        list of negatives — e.g. ``words_nearest(["king", "woman"],
+        ["man"])`` — queried as sum(positive) - sum(negative), with the
+        query words excluded from the result."""
+        if isinstance(negative, int):       # words_nearest(word, 5) form
+            top_n, negative = negative, None
+        if isinstance(negative, str):       # single negative word
+            negative = [negative]
+        if negative:
+            if isinstance(word_or_vec, str):
+                word_or_vec = [word_or_vec]
+            elif not isinstance(word_or_vec, (list, tuple)):
+                raise ValueError(
+                    "negative words require word-name positives, not a "
+                    "raw vector")
         if isinstance(word_or_vec, str):
             vec = self.word_vector(word_or_vec)
             exclude = {word_or_vec}
             if vec is None:
                 return []
+        elif isinstance(word_or_vec, (list, tuple)) \
+                and word_or_vec and isinstance(word_or_vec[0], str):
+            pos = [self.word_vector(w) for w in word_or_vec]
+            neg = [self.word_vector(w) for w in (negative or [])]
+            if any(v is None for v in pos + neg):
+                return []
+            vec = np.sum(pos, axis=0)
+            if neg:
+                vec = vec - np.sum(neg, axis=0)
+            exclude = set(word_or_vec) | set(negative or [])
         else:
             vec = np.asarray(word_or_vec)
             exclude = set()
@@ -503,6 +532,9 @@ class SequenceVectors:
             if len(out) >= top_n:
                 break
         return out
+
+    # reference wordsNearestSum: same additive-combination query
+    words_nearest_sum = words_nearest
 
 
 class Word2Vec(SequenceVectors):

@@ -254,8 +254,9 @@ class ClusterWord2Vec:
     def similarity(self, w1: str, w2: str) -> float:
         return self.model.similarity(w1, w2)
 
-    def words_nearest(self, word_or_vec, top_n: int = 10):
-        return self.model.words_nearest(word_or_vec, top_n)
+    def words_nearest(self, word_or_vec, negative=None, top_n: int = 10):
+        return self.model.words_nearest(word_or_vec, negative,
+                                        top_n=top_n)
 
     def has_word(self, word: str) -> bool:
         return self.model.has_word(word)

@@ -361,3 +361,72 @@ def test_paragraph_vectors_batches_across_documents(monkeypatch):
     pv.fit(docs)
     # 30 docs worth of pairs fit one 4096 batch: exactly 1 flush dispatch
     assert calls["n"] == 1
+
+
+def test_words_nearest_analogy_form():
+    """Reference wordsNearest(positive, negative, top): sum(pos)-sum(neg)
+    query with query words excluded.  Constructed vectors make the
+    analogy answer unambiguous."""
+    from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+    from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+    words = ["king", "queen", "man", "woman", "apple"]
+    vecs = {
+        "king":  [1.0, 1.0, 0.0],
+        "queen": [1.0, 0.0, 1.0],
+        "man":   [0.0, 1.0, 0.0],
+        "woman": [0.0, 0.0, 1.0],
+        "apple": [-1.0, -1.0, -1.0],
+    }
+    sv = SequenceVectors(layer_size=3)
+    cache = VocabCache()
+    for i, w in enumerate(words):
+        # descending frequency keeps index order == insertion order
+        cache.add_token(VocabWord(w, element_frequency=10.0 - i))
+    cache.finalize_vocab()
+    sv.vocab = cache
+    lt = InMemoryLookupTable(cache, 3, seed=0)
+    import numpy as np
+    lt.syn0 = np.asarray([vecs[cache.word_at_index(i)]
+                          for i in range(len(words))], np.float32)
+    sv.lookup_table = lt
+    # king - man + woman = [1,0,1] = queen exactly
+    assert sv.words_nearest(["king", "woman"], ["man"], top_n=1) \
+        == ["queen"]
+    assert sv.words_nearest_sum(["king", "woman"], ["man"], top_n=1) \
+        == ["queen"]
+    # unknown word in the query -> empty result (reference behavior)
+    assert sv.words_nearest(["king", "zzz"], ["man"]) == []
+    # plain single-word form still works, positionally too
+    assert sv.words_nearest("king", 2) == sv.words_nearest("king",
+                                                           top_n=2)
+
+
+def test_words_nearest_analogy_input_normalization():
+    """Single-string positives/negatives normalize to lists; raw-vector
+    positives with negatives are rejected."""
+    from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+    from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+    import numpy as np
+
+    words = ["king", "queen", "man", "woman"]
+    vecs = {"king": [1.0, 1.0, 0.0], "queen": [1.0, 0.0, 1.0],
+            "man": [0.0, 1.0, 0.0], "woman": [0.0, 0.0, 1.0]}
+    sv = SequenceVectors(layer_size=3)
+    cache = VocabCache()
+    for i, w in enumerate(words):
+        cache.add_token(VocabWord(w, element_frequency=10.0 - i))
+    cache.finalize_vocab()
+    sv.vocab = cache
+    lt = InMemoryLookupTable(cache, 3, seed=0)
+    sv.lookup_table = lt
+    lt.syn0 = np.asarray([vecs[cache.word_at_index(i)]
+                          for i in range(len(words))], np.float32)
+    # single-string positive and negative both normalize
+    a = sv.words_nearest("king", ["man"], top_n=1)
+    b = sv.words_nearest(["king"], "man", top_n=1)
+    assert a == b == sv.words_nearest(["king"], ["man"], top_n=1)
+    with pytest.raises(ValueError, match="raw vector"):
+        sv.words_nearest(np.ones(3, np.float32), ["man"])
