@@ -74,6 +74,44 @@ class VGG16ImagePreProcessor:
     __call__ = transform
 
 
+class ImageNetLabels:
+    """Class-index -> label decoding (reference
+    ``modelimport/.../Utils/ImageNetLabels.java``: decodePredictions).
+
+    The reference downloads the 1000 ImageNet label strings; in a
+    zero-egress build the labels come from a user-supplied file (one
+    label per line, index order) and default to ``class_0000``-style
+    placeholders.
+    """
+
+    def __init__(self, labels_path: Optional[str] = None,
+                 labels: Optional[list] = None, n_classes: int = 1000):
+        if labels is not None:
+            self.labels = list(labels)
+        elif labels_path is not None:
+            with open(labels_path, "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
+        else:
+            self.labels = [f"class_{i:04d}" for i in range(n_classes)]
+
+    def label(self, idx: int) -> str:
+        return self.labels[idx]
+
+    def decode_predictions(self, predictions, top: int = 5):
+        """(batch, classes) probabilities -> per-example
+        [(label, probability), ...] of the ``top`` most probable classes
+        (reference ``decodePredictions``)."""
+        p = np.asarray(predictions)
+        if p.ndim == 1:
+            p = p[None]
+        if p.shape[-1] != len(self.labels):
+            raise ValueError(f"{p.shape[-1]} classes vs "
+                             f"{len(self.labels)} labels")
+        order = np.argsort(-p, axis=-1)[:, :top]
+        return [[(self.labels[int(c)], float(row_p[int(c)]))
+                 for c in row] for row, row_p in zip(order, p)]
+
+
 def load_vgg16(weights_path: Optional[str] = None,
                n_classes: int = 1000,
                include_top: bool = True) -> MultiLayerNetwork:

@@ -333,3 +333,39 @@ def test_roc_rejects_multiclass_labels():
     from deeplearning4j_tpu.eval.roc import ROC
     with pytest.raises(ValueError, match="ROCMultiClass"):
         ROC().eval(np.eye(3)[[0, 1, 2]], np.eye(3)[[0, 1, 2]])
+
+
+def test_early_stopping_with_computation_graph():
+    """Reference EarlyStoppingGraphTrainer: the harness drives a
+    ComputationGraph end-to-end (duck-typed fit/score/clone)."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+        MaxEpochsTerminationCondition)
+    from deeplearning4j_tpu.earlystopping.scorecalc import \
+        DataSetLossCalculator
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    g = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.3)
+         .weight_init("xavier").graph_builder()
+         .add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                    "in")
+         .add_layer("out", OutputLayer(n_in=8, n_out=2), "d")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    X = np.float32(rng.randn(120, 4))
+    Y = np.float32(np.eye(2)[(X[:, 0] > 0).astype(int)])
+    train_it = ListDataSetIterator(DataSet(X, Y), 32)
+    val_it = ListDataSetIterator(DataSet(X, Y), 64)
+    saver = InMemoryModelSaver()
+    conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+        score_calculator=DataSetLossCalculator(val_it),
+        model_saver=saver, evaluate_every_n_epochs=1)
+    result = EarlyStoppingTrainer(conf, net, train_it).fit()
+    assert result.total_epochs >= 1
+    best = result.best_model
+    assert best is not None
+    assert best.score(DataSet(X, Y)) < 0.6

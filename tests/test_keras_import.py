@@ -359,3 +359,21 @@ def test_vgg16_weight_loading(tmp_path):
         net3 = tm.load_vgg16(path, n_classes=7)
     np.testing.assert_array_equal(net3.get_flat_params(),
                                   net.get_flat_params())
+
+
+def test_imagenet_labels_decode_predictions(tmp_path):
+    from deeplearning4j_tpu.keras.trained_models import ImageNetLabels
+    # placeholder labels
+    lab = ImageNetLabels(n_classes=4)
+    p = np.array([[0.1, 0.6, 0.05, 0.25],
+                  [0.7, 0.1, 0.1, 0.1]])
+    out = lab.decode_predictions(p, top=2)
+    assert out[0] == [("class_0001", 0.6), ("class_0003", 0.25)]
+    assert out[1][0] == ("class_0000", 0.7)
+    # file-loaded labels
+    f = tmp_path / "labels.txt"
+    f.write_text("cat\ndog\nfox\nowl\n")
+    lab2 = ImageNetLabels(labels_path=str(f))
+    assert lab2.decode_predictions(p[0], top=1) == [[("dog", 0.6)]]
+    with pytest.raises(ValueError, match="labels"):
+        lab2.decode_predictions(np.zeros((1, 7)))
