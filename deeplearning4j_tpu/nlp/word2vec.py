@@ -536,6 +536,44 @@ class SequenceVectors:
     # reference wordsNearestSum: same additive-combination query
     words_nearest_sum = words_nearest
 
+    def similar_words_in_vocab_to(self, word: str,
+                                  accuracy: float) -> List[str]:
+        """Vocab words whose string similarity to ``word`` is >=
+        ``accuracy`` (reference ``similarWordsInVocabTo`` /
+        ``MathUtils.stringSimilarity``)."""
+        import difflib
+        if self.vocab is None:
+            return []
+        # one matcher, query cached as seq2 (the side difflib indexes);
+        # quick-ratio upper bounds prune before the quadratic ratio()
+        sm = difflib.SequenceMatcher(None)
+        sm.set_seq2(word)
+        out = []
+        for w in self.vocab.words():
+            sm.set_seq1(w)
+            if sm.real_quick_ratio() >= accuracy \
+                    and sm.quick_ratio() >= accuracy \
+                    and sm.ratio() >= accuracy:
+                out.append(w)
+        return out
+
+    def word_vectors(self, words) -> np.ndarray:
+        """(n, layer_size) matrix of the vectors for the given words,
+        skipping out-of-vocab entries (reference ``getWordVectors``)."""
+        vecs = [self.word_vector(w) for w in words]
+        vecs = [v for v in vecs if v is not None]
+        if not vecs:
+            return np.zeros((0, self.layer_size), np.float32)
+        return np.stack(vecs)
+
+    def word_vectors_mean(self, words) -> np.ndarray:
+        """Mean vector over in-vocab words (reference
+        ``getWordVectorsMean``)."""
+        m = self.word_vectors(words)
+        if m.shape[0] == 0:
+            return np.zeros((self.layer_size,), np.float32)
+        return m.mean(axis=0)
+
 
 class Word2Vec(SequenceVectors):
     """Word2Vec over text corpora (reference ``models/word2vec/

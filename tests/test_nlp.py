@@ -430,3 +430,25 @@ def test_words_nearest_analogy_input_normalization():
     assert a == b == sv.words_nearest(["king"], ["man"], top_n=1)
     with pytest.raises(ValueError, match="raw vector"):
         sv.words_nearest(np.ones(3, np.float32), ["man"])
+
+
+def test_word_vectors_mean_and_similar_words():
+    from deeplearning4j_tpu.nlp.lookup_table import InMemoryLookupTable
+    from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+    import numpy as np
+    sv = SequenceVectors(layer_size=2)
+    cache = VocabCache()
+    for i, w in enumerate(["night", "light", "apple"]):
+        cache.add_token(VocabWord(w, element_frequency=5.0 - i))
+    cache.finalize_vocab()
+    sv.vocab = cache
+    lt = InMemoryLookupTable(cache, 2, seed=0)
+    lt.syn0 = np.asarray([[1, 0], [0, 1], [2, 2]], np.float32)
+    sv.lookup_table = lt
+    np.testing.assert_allclose(sv.word_vectors_mean(["night", "light"]),
+                               [0.5, 0.5])
+    assert sv.word_vectors(["night", "zzz"]).shape == (1, 2)
+    assert sv.word_vectors(["zzz"]).shape == (0, 2)
+    sim = sv.similar_words_in_vocab_to("might", 0.7)
+    assert "night" in sim and "light" in sim and "apple" not in sim
