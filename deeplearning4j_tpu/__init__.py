@@ -12,5 +12,19 @@ __version__ = "0.1.0"
 
 from .nn.conf.neural_net_configuration import (  # noqa: F401
     NeuralNetConfiguration, MultiLayerConfiguration)
+from .nn.conf.computation_graph import (  # noqa: F401
+    ComputationGraphConfiguration)
 from .nn.multilayer import MultiLayerNetwork  # noqa: F401
+from .nn.computation_graph import ComputationGraph  # noqa: F401
 from .datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from .eval.evaluation import Evaluation  # noqa: F401
+from .utils.model_serializer import (  # noqa: F401
+    restore_computation_graph, restore_multi_layer_network, write_model)
+
+__all__ = [
+    "NeuralNetConfiguration", "MultiLayerConfiguration",
+    "ComputationGraphConfiguration", "MultiLayerNetwork",
+    "ComputationGraph", "DataSet", "MultiDataSet", "Evaluation",
+    "write_model", "restore_multi_layer_network",
+    "restore_computation_graph",
+]
