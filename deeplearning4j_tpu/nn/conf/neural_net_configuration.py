@@ -35,6 +35,9 @@ class GlobalConfig:
     num_iterations: int = 1
     optimization_algo: str = "stochastic_gradient_descent"
     mini_batch: bool = True          # average score/grads over batch
+    # stored+serialized but intentionally unconsumed: the reference's 0.7.3
+    # optimize path also never reads it (step direction comes from the
+    # optimizer's step function, BaseOptimizer.getDefaultStepFunctionForOptimizer)
     minimize: bool = True
     dtype: str = "float32"           # param dtype; bfloat16 compute opt-in
     compute_dtype: Optional[str] = None  # e.g. "bfloat16" for MXU-friendly matmuls
