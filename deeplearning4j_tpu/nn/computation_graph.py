@@ -526,6 +526,9 @@ class ComputationGraph:
         for name in self._layer_names():
             if getattr(self.vertices[name].layer, "IS_PRETRAINABLE", False):
                 self.pretrain_layer(name, data, epochs)
+        # fit() must not re-run pretraining (and the flag serializes, so a
+        # restored model doesn't re-pretrain over fine-tuned weights)
+        self._pretrain_done = True
         return self
 
     def pretrain_layer(self, name: str, data,

@@ -487,6 +487,9 @@ class MultiLayerNetwork:
         for i, layer in enumerate(self.layers):
             if getattr(layer, "IS_PRETRAINABLE", False):
                 self.pretrain_layer(i, data, epochs)
+        # fit() must not re-run pretraining (and the flag serializes, so a
+        # restored model doesn't re-pretrain over fine-tuned weights)
+        self._pretrain_done = True
         return self
 
     def pretrain_layer(self, i: int, data,

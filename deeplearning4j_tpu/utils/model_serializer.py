@@ -40,6 +40,9 @@ def write_model(net, path: str, save_updater: bool = True) -> None:
         "num_params": int(flat.size),
         "iteration": int(getattr(net, "iteration", 0)),
         "epoch": int(getattr(net, "epoch", 0)),
+        # without this, restoring a pretrain=True model and calling fit()
+        # would re-run unsupervised pretraining over the fine-tuned weights
+        "pretrain_done": bool(getattr(net, "_pretrain_done", False)),
         "state": state_manifest,
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -91,6 +94,7 @@ def _restore_into(net, zf: zipfile.ZipFile, load_updater: bool) -> None:
         manifest = json.loads(zf.read(MANIFEST_JSON))
         net.iteration = manifest.get("iteration", 0)
         net.epoch = manifest.get("epoch", 0)
+        net._pretrain_done = manifest.get("pretrain_done", False)
         if STATE_BIN in names and manifest.get("state"):
             _unflatten_state(net, np.frombuffer(zf.read(STATE_BIN), "<f4"),
                              manifest["state"])

@@ -421,3 +421,76 @@ def test_model_serializer_round_trip_with_pretrain_layers(tmp_path):
     ds = _data(positive=True)
     np.testing.assert_allclose(restored.output(ds.features),
                                net.output(ds.features))
+
+
+# ------------------------------------ full workflow: the reference chain
+
+def test_pretrain_finetune_serialize_resume_chain(tmp_path):
+    """The reference's classic workflow as ONE chain: unsupervised
+    pretrain -> supervised fine-tune -> writeModel -> restore ->
+    resume training.  Guards that pretrain state, updater state and the
+    pretrain-done flag survive the zip round trip."""
+    from deeplearning4j_tpu import (restore_multi_layer_network,
+                                    write_model)
+
+    rng = np.random.RandomState(7)
+    n = 120
+    y = rng.randint(0, 3, n)
+    x = np.float32(rng.rand(n, 8) * 0.5 + np.eye(3)[y][:, :1] * 0.3)
+    ds = DataSet(x, np.float32(np.eye(3)[y]))
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater("adam")
+            .learning_rate(5e-3).weight_init("xavier")
+            .list().pretrain(True)
+            .layer(AutoEncoder(n_in=8, n_out=5, activation="sigmoid"))
+            .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain(ds, epochs=5)
+    net.fit(ds, epochs=10)                      # supervised fine-tune
+    mid_score = net.score(ds)
+
+    p = str(tmp_path / "chain.zip")
+    write_model(net, p)
+    again = restore_multi_layer_network(p)
+    # restored model predicts identically
+    np.testing.assert_allclose(net.output(x), again.output(x), atol=1e-6)
+    assert again.score(ds) == pytest.approx(mid_score, rel=1e-5)
+
+    # resume: further training improves (or at least never diverges) and
+    # does NOT re-run pretraining (flag restored)
+    assert again._pretrain_done
+    again.fit(ds, epochs=30)
+    assert again.score(ds) < mid_score
+
+
+def test_explicit_pretrain_sets_done_flag():
+    """pretrain() itself marks pretraining done — fit() must not run a
+    second unsupervised pass, and save-after-pretrain must carry the
+    flag (both network containers)."""
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(1e-2)
+            .list().pretrain(True)
+            .layer(AutoEncoder(n_in=4, n_out=3, activation="sigmoid"))
+            .layer(OutputLayer(n_in=3, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    ds = DataSet(np.float32(rng.rand(16, 4)),
+                 np.float32(np.eye(2)[rng.randint(0, 2, 16)]))
+    net.pretrain(ds, epochs=1)
+    assert net._pretrain_done
+
+    g = (NeuralNetConfiguration.builder().seed(0).learning_rate(1e-2)
+         .graph_builder().add_inputs("in")
+         .add_layer("ae", AutoEncoder(n_in=4, n_out=3,
+                                      activation="sigmoid"), "in")
+         .add_layer("out", OutputLayer(n_in=3, n_out=2), "ae")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    cg.pretrain(DataSet(np.float32(rng.rand(16, 4)),
+                        np.float32(np.eye(2)[rng.randint(0, 2, 16)])),
+                epochs=1)
+    assert cg._pretrain_done
