@@ -204,3 +204,39 @@ def test_rnn_time_step_batch_mismatch_raises():
         net.rnn_time_step(np.zeros((2, 3)))
     net.rnn_clear_previous_state()
     net.rnn_time_step(np.zeros((2, 3)))
+
+
+def test_parallel_fit_serialize_resume_chain(tmp_path):
+    """ParallelWrapper training -> writeModel -> restore -> resume with
+    plain single-process fit(): the wrapper must leave the net in a
+    fully serializable, resumable state (params, updater state,
+    iteration counter)."""
+    from deeplearning4j_tpu import (restore_multi_layer_network,
+                                    write_model)
+
+    net = MultiLayerNetwork(_conf(updater="adam", lr=0.01)).init()
+    batches = _batches(8)
+    pw = ParallelWrapper(net, workers=4, averaging_frequency=2)
+    pw.fit(batches)
+    it_after_pw = net.iteration
+    assert it_after_pw > 0
+
+    p = str(tmp_path / "pw.zip")
+    write_model(net, p)
+    again = restore_multi_layer_network(p)
+    x = batches[0].features
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(again.output(x)), atol=1e-6)
+    assert again.iteration == it_after_pw
+    # updater state round-trips exactly
+    np.testing.assert_allclose(np.asarray(again.get_flat_updater_state()),
+                               np.asarray(net.get_flat_updater_state()),
+                               atol=1e-6)
+    # resume single-process: the restored net must track the original
+    # net step-for-step (requires Adam moments, not just params)
+    for _ in range(2):
+        net.fit(batches[0])
+        again.fit(batches[0])
+    np.testing.assert_allclose(np.asarray(again.get_flat_params()),
+                               np.asarray(net.get_flat_params()),
+                               atol=1e-5)
