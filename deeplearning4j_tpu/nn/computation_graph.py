@@ -536,6 +536,8 @@ class ComputationGraph:
         self.init()
         if not getattr(self.vertices[name].layer, "IS_PRETRAINABLE", False):
             return self
+        if getattr(self.vertices[name].layer, "frozen", False):
+            return self          # frozen extractor: pretraining is a no-op
         step = self._pretrain_step(name)
         batches = ([data] if isinstance(data, (DataSet, MultiDataSet))
                    else data)

@@ -56,6 +56,10 @@ class BaseLayerConfig:
     updater: Optional[UpdaterConfig] = None  # None -> network default
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
+    # frozen layers take no parameter updates (transfer-learning feature
+    # extractors — reference FrozenLayer semantics); forward/dropout/
+    # regularization reporting behave normally
+    frozen: bool = False
 
     _INHERITABLE = ("activation", "weight_init", "dist", "bias_init",
                     "dropout", "l1", "l2", "l1_bias", "l2_bias", "updater",

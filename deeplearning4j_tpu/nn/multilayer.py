@@ -500,6 +500,8 @@ class MultiLayerNetwork:
         layer = self.layers[i]
         if not getattr(layer, "IS_PRETRAINABLE", False):
             return self
+        if getattr(layer, "frozen", False):
+            return self          # frozen extractor: pretraining is a no-op
         step = self._pretrain_step(i)
         if isinstance(data, DataSet):
             data_iter: Sequence[DataSet] = [data]

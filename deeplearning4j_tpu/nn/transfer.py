@@ -1,0 +1,146 @@
+"""Transfer learning: freeze a feature extractor, swap the head, keep
+the pretrained weights.
+
+The reference grows this API one minor version after 0.7.3
+(``TransferLearning.Builder`` / ``FrozenLayer``); it is included here
+because it is the natural consumer of a trained Keras import or
+pretrained zoo model, and the TPU build's per-layer config inheritance
+makes it nearly free: frozen layers are plain configs with
+``frozen=True`` (skipped by ``updaters.apply_layer_updates``), so the
+whole fine-tune step still compiles to one XLA program.
+
+Typical use::
+
+    new_net = (TransferLearning.builder(trained_net)
+               .fine_tune_learning_rate(1e-4)
+               .set_feature_extractor(1)      # freeze layers 0..1
+               .remove_layers_from(3)          # drop the old head
+               .add_layer(OutputLayer(n_in=64, n_out=5))
+               .build())
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+class TransferLearning:
+    """Namespace mirroring the reference's ``TransferLearning.Builder``."""
+
+    @staticmethod
+    def builder(net) -> "TransferLearningBuilder":
+        return TransferLearningBuilder(net)
+
+
+class TransferLearningBuilder:
+    def __init__(self, net):
+        from .multilayer import MultiLayerNetwork
+        if not isinstance(net, MultiLayerNetwork):
+            raise ValueError(
+                "TransferLearning operates on MultiLayerNetwork; build "
+                "graph surgery with GraphBuilder directly")
+        net.init()
+        self._src = net
+        self._conf = copy.deepcopy(net.conf)
+        self._keep = len(self._conf.layers)     # layers [0, _keep) retained
+        self._frozen_up_to = -1
+        self._added: List[object] = []
+        self._lr: Optional[float] = None
+        self._updater: Optional[str] = None
+
+    # ---------------------------------------------------------- fine-tune
+    def fine_tune_learning_rate(self, lr: float) -> "TransferLearningBuilder":
+        """Override the network learning rate for the fine-tune phase
+        (reference ``FineTuneConfiguration.learningRate``)."""
+        self._lr = float(lr)
+        return self
+
+    def fine_tune_updater(self, updater: str) -> "TransferLearningBuilder":
+        self._updater = updater
+        return self
+
+    # ------------------------------------------------------------ surgery
+    def set_feature_extractor(self, layer_index: int
+                              ) -> "TransferLearningBuilder":
+        """Freeze layers ``0..layer_index`` inclusive (reference
+        ``setFeatureExtractor``)."""
+        self._frozen_up_to = int(layer_index)
+        return self
+
+    def remove_output_layer(self) -> "TransferLearningBuilder":
+        return self.remove_layers_from(self._keep - 1)
+
+    def remove_layers_from(self, layer_index: int
+                           ) -> "TransferLearningBuilder":
+        """Drop layers ``layer_index..end`` (reference
+        ``removeLayersFromOutput``)."""
+        if not 0 <= layer_index <= self._keep:
+            raise ValueError(f"layer_index {layer_index} out of range "
+                             f"[0, {self._keep}]")
+        self._keep = int(layer_index)
+        return self
+
+    def add_layer(self, layer) -> "TransferLearningBuilder":
+        """Append a freshly initialized layer config (reference
+        ``addLayer``)."""
+        self._added.append(layer)
+        return self
+
+    # -------------------------------------------------------------- build
+    def build(self):
+        from .multilayer import MultiLayerNetwork
+
+        if self._frozen_up_to >= self._keep:
+            raise ValueError(
+                f"cannot freeze through layer {self._frozen_up_to}: only "
+                f"{self._keep} layers are retained (added layers are new "
+                f"heads and train by definition)")
+        # never mutate the builder's stored conf: build() must be
+        # repeatable and must not alter the source network's conf
+        conf = copy.deepcopy(self._conf)
+        kept_layers = [copy.deepcopy(l) for l in conf.layers[:self._keep]]
+        for i, layer in enumerate(kept_layers):
+            # preserve freezes inherited from a previous transfer
+            layer.frozen = layer.frozen or i <= self._frozen_up_to
+        if self._lr is not None:
+            conf.conf.updater.learning_rate = self._lr
+        if self._updater is not None:
+            conf.conf.updater.updater = self._updater
+        # kept layers carry their own finalized updater confs (aliasing
+        # with the global conf was broken by deepcopy), so fine-tune
+        # overrides must be pushed into each unfrozen kept layer too
+        for layer in kept_layers:
+            if layer.frozen or layer.updater is None:
+                continue
+            if self._lr is not None:
+                layer.updater.learning_rate = self._lr
+            if self._updater is not None:
+                layer.updater.updater = self._updater
+        added = [copy.deepcopy(l) for l in self._added]
+        for layer in added:
+            # new layers inherit the (possibly overridden) global defaults
+            layer.finalize_defaults(conf.conf.layer_defaults())
+        conf.layers = kept_layers + added
+        if not conf.layers:
+            raise ValueError("transfer result has no layers")
+        # preprocessors of removed layers are dropped (an old head's
+        # preprocessor must not apply to a newly added layer at its index)
+        conf.input_preprocessors = {
+            i: p for i, p in conf.input_preprocessors.items()
+            if i < self._keep}
+
+        net = MultiLayerNetwork(conf).init()
+        # transfer params + layer state for every retained layer.  COPY,
+        # don't alias: the new net's train step donates its param buffers,
+        # and a shared buffer would be deleted out from under the source
+        # network on the first fine-tune step.
+        for i in range(self._keep):
+            net.params[i] = {k: jnp.array(v, copy=True)
+                             for k, v in self._src.params[i].items()}
+            net.net_state[i] = {k: jnp.array(v, copy=True)
+                                for k, v in self._src.net_state[i].items()}
+        return net

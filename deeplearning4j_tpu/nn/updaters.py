@@ -308,6 +308,9 @@ def apply_layer_updates(uconf: UpdaterConfig, layer, params: ParamTree,
     ``layer.direct_update_params()`` routed around all of it and applied
     verbatim (``p -= g``; reference per-param ``Updater.NONE`` + lr 1.0,
     e.g. center-loss cL)."""
+    if getattr(layer, "frozen", False):
+        # feature-extractor layer: parameters (and updater state) fixed
+        return dict(params), state
     g = dict(grads)
     g_direct = {k: g.pop(k) for k in layer.direct_update_params() if k in g}
     g = regularize(g, params, layer.l1_by_param(), layer.l2_by_param())

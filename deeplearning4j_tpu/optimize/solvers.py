@@ -214,12 +214,38 @@ class Solver:
         return loss
 
     @functools.cached_property
+    def _trainable_mask(self):
+        """Flat 1/0 mask over the raveled param vector: 0 for params of
+        frozen layers."""
+        import jax.numpy as jnp
+
+        def ones_or_zeros(layer, tree):
+            return jax.tree.map(
+                (jnp.zeros_like if getattr(layer, "frozen", False)
+                 else jnp.ones_like), tree)
+
+        net = self.net
+        if hasattr(net, "layers"):                       # MultiLayerNetwork
+            mask_tree = [ones_or_zeros(layer, net.params[i])
+                         for i, layer in enumerate(net.layers)]
+        else:                                            # ComputationGraph
+            mask_tree = {
+                name: ones_or_zeros(net.vertices[name].layer,
+                                    net.params[name])
+                for name in net.params}
+        flat, _ = ravel_pytree(mask_tree)
+        return flat
+
+    @functools.cached_property
     def _step_fn(self):
         def step(flat_w, state, net_state, base_rng, features, labels,
                  fmask, lmask):
             loss = self._flat_loss(net_state, (features, labels, fmask,
                                                lmask))
             f0, g = jax.value_and_grad(loss)(flat_w)
+            # frozen layers (transfer-learning) contribute no gradient, so
+            # directions, line searches and steps leave them untouched
+            g = g * self._trainable_mask
             # Scale-invariant start for steepest-descent searches: a unit
             # step along a huge raw gradient overshoots past every
             # backtrack level (reference BackTrackLineSearch rescales the

@@ -164,6 +164,12 @@ class ZeroShardedParallelWrapper:
                     g, layer.gradient_normalization,
                     layer.gradient_normalization_threshold)
                 for layer, g in zip(net.layers, grads)]
+            # frozen layers (transfer-learning feature extractors) take no
+            # update on this path either — zero AFTER regularization so
+            # l2 decay cannot leak into them
+            grads = [jax.tree.map(jnp.zeros_like, g)
+                     if getattr(layer, "frozen", False) else g
+                     for layer, g in zip(net.layers, grads)]
             flat_g, _ = ravel_pytree(grads)
             flat_p, _ = ravel_pytree(params)
             flat_g = jnp.pad(flat_g, (0, padded - total))

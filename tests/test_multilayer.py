@@ -282,3 +282,148 @@ def test_score_examples_empty_iterator():
     net = MultiLayerNetwork(conf).init()
     out = net.score_examples(iter([]))
     assert out.shape == (0,)
+
+
+# ---------------------------------------------------------- TransferLearning
+
+def test_transfer_learning_freeze_and_new_head():
+    """Freeze the feature extractor, swap the head for a new class count:
+    frozen params stay bitwise identical through fine-tuning, the new
+    head trains, and transferred weights carry over."""
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    rng = np.random.RandomState(0)
+    X = np.float32(rng.randn(200, 6))
+    y3 = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    src = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(1).updater("adam")
+         .learning_rate(5e-3).weight_init("xavier").activation("tanh")
+         .list()
+         .layer(DenseLayer(n_in=6, n_out=16))
+         .layer(DenseLayer(n_in=16, n_out=8))
+         .layer(OutputLayer(n_in=8, n_out=3))
+         .build())).init()
+    src.fit(DataSet(X, np.float32(np.eye(3)[y3])), epochs=30)
+
+    # new 2-class task on the same features
+    y2 = (X[:, 0] + X[:, 1] > 0).astype(int)
+    new = (TransferLearning.builder(src)
+           .fine_tune_learning_rate(1e-2)
+           .set_feature_extractor(1)          # freeze both dense layers
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_in=8, n_out=2))
+           .build())
+    assert len(new.layers) == 3
+    assert new.layers[0].frozen and new.layers[1].frozen
+    assert not new.layers[2].frozen
+    # transferred weights equal the source's
+    np.testing.assert_array_equal(np.asarray(new.params[0]["W"]),
+                                  np.asarray(src.params[0]["W"]))
+
+    frozen_before = np.asarray(new.params[1]["W"]).copy()
+    head_before = np.asarray(new.params[2]["W"]).copy()
+    new.fit(DataSet(X, np.float32(np.eye(2)[y2])), epochs=40)
+    np.testing.assert_array_equal(np.asarray(new.params[1]["W"]),
+                                  frozen_before)       # frozen: unchanged
+    assert not np.allclose(np.asarray(new.params[2]["W"]), head_before)
+    acc = (new.predict(X) == y2).mean()
+    assert acc > 0.85
+
+
+def test_transfer_learning_frozen_flag_serializes(tmp_path):
+    from deeplearning4j_tpu import (restore_multi_layer_network,
+                                    write_model)
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    src = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(2).list()
+         .layer(DenseLayer(n_in=4, n_out=5))
+         .layer(OutputLayer(n_in=5, n_out=2))
+         .build())).init()
+    new = (TransferLearning.builder(src)
+           .set_feature_extractor(0)
+           .build())
+    p = str(tmp_path / "tl.zip")
+    write_model(new, p)
+    again = restore_multi_layer_network(p)
+    assert again.layers[0].frozen and not again.layers[1].frozen
+    rng = np.random.RandomState(0)
+    ds = DataSet(np.float32(rng.randn(8, 4)),
+                 np.float32(np.eye(2)[rng.randint(0, 2, 8)]))
+    w0 = np.asarray(again.params[0]["W"]).copy()
+    again.fit(ds, epochs=3)
+    np.testing.assert_array_equal(np.asarray(again.params[0]["W"]), w0)
+
+
+def test_transfer_learning_validation():
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    src = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(3).list()
+         .layer(DenseLayer(n_in=4, n_out=5))
+         .layer(OutputLayer(n_in=5, n_out=2))
+         .build())).init()
+    with pytest.raises(ValueError, match="out of range"):
+        TransferLearning.builder(src).remove_layers_from(7)
+    with pytest.raises(ValueError, match="freeze"):
+        (TransferLearning.builder(src).set_feature_extractor(5).build())
+    with pytest.raises(ValueError, match="no layers"):
+        TransferLearning.builder(src).remove_layers_from(0).build()
+
+
+def test_transfer_fine_tune_lr_applies_to_kept_unfrozen_layers():
+    """The lr override must reach kept unfrozen layers, whose updater
+    confs were finalized (and de-aliased) at original build time."""
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+    src = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+         .learning_rate(0.5).list()
+         .layer(DenseLayer(n_in=4, n_out=5))
+         .layer(DenseLayer(n_in=5, n_out=5))
+         .layer(OutputLayer(n_in=5, n_out=2))
+         .build())).init()
+    new = (TransferLearning.builder(src)
+           .fine_tune_learning_rate(1e-3)
+           .set_feature_extractor(0)
+           .build())
+    assert new.layers[1].updater.learning_rate == pytest.approx(1e-3)
+    assert new.layers[2].updater.learning_rate == pytest.approx(1e-3)
+    # build() twice produces the same architecture (no duplicated head)
+    b = TransferLearning.builder(src).remove_output_layer() \
+        .add_layer(OutputLayer(n_in=5, n_out=4))
+    n1, n2 = b.build(), b.build()
+    assert len(n1.layers) == len(n2.layers) == 3
+    assert len(src.conf.layers) == 3      # source conf untouched
+    # chained transfer preserves earlier freezes by default
+    first = (TransferLearning.builder(src).set_feature_extractor(0)
+             .build())
+    second = (TransferLearning.builder(first).remove_output_layer()
+              .add_layer(OutputLayer(n_in=5, n_out=4)).build())
+    assert second.layers[0].frozen
+    with pytest.raises(ValueError, match="freeze"):
+        # cannot freeze into the added-head range
+        (TransferLearning.builder(src).remove_output_layer()
+         .set_feature_extractor(2)
+         .add_layer(OutputLayer(n_in=5, n_out=4)).build())
+
+
+def test_frozen_respected_by_solver_path():
+    """LBFGS/line-search solvers operate on the raveled param vector; the
+    trainable mask must keep frozen layers fixed there too."""
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+    src = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(2).updater("sgd")
+         .learning_rate(0.1).weight_init("xavier").list()
+         .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+         .layer(OutputLayer(n_in=6, n_out=2))
+         .build())).init()
+    new = (TransferLearning.builder(src).set_feature_extractor(0).build())
+    new.conf.conf.optimization_algo = "lbfgs"
+    rng = np.random.RandomState(0)
+    ds = DataSet(np.float32(rng.randn(32, 4)),
+                 np.float32(np.eye(2)[rng.randint(0, 2, 32)]))
+    w_frozen = np.asarray(new.params[0]["W"]).copy()
+    s0 = new.score(ds)
+    new.fit(ds, epochs=5)
+    np.testing.assert_array_equal(np.asarray(new.params[0]["W"]), w_frozen)
+    assert new.score(ds) < s0          # head still optimizes

@@ -198,3 +198,20 @@ def test_zero_rejects_per_layer_updater_overrides():
     net = MultiLayerNetwork(conf).init()
     with pytest.raises(ValueError, match="ONE updater config"):
         ZeroShardedParallelWrapper(net, workers=2)
+
+
+def test_zero_respects_frozen_layers():
+    """Frozen (transfer-learning) layers must stay fixed under the
+    ZeRO-sharded update path exactly as on the replicated path —
+    including when l2 would otherwise decay them."""
+    conf = _conf(updater="adam", lr=0.05, l2=0.01)
+    net = MultiLayerNetwork(conf).init()
+    net.conf.layers[0].frozen = True
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(8, 4), np.eye(3)[rng.randint(0, 3, 8)])
+               for _ in range(4)]
+    w0 = np.asarray(net.params[0]["W"]).copy()
+    head0 = np.asarray(net.params[1]["W"]).copy()
+    ZeroShardedParallelWrapper(net, workers=4).fit(batches)
+    np.testing.assert_array_equal(np.asarray(net.params[0]["W"]), w0)
+    assert not np.allclose(np.asarray(net.params[1]["W"]), head0)
