@@ -28,12 +28,43 @@ from typing import List, Optional
 import jax.numpy as jnp
 
 
+def _apply_fine_tune_overrides(layers, global_updater, lr, updater):
+    """Push fine-tune lr/updater into the global conf AND each unfrozen
+    layer's finalized (de-aliased) updater conf."""
+    if lr is not None:
+        global_updater.learning_rate = lr
+    if updater is not None:
+        global_updater.updater = updater
+    for layer in layers:
+        if layer is None or getattr(layer, "frozen", False) \
+                or layer.updater is None:
+            continue
+        if lr is not None:
+            layer.updater.learning_rate = lr
+        if updater is not None:
+            layer.updater.updater = updater
+
+
+def _copy_entry(src_net, dst_net, key):
+    """Deep-copy one layer's params+state (donation in the new net's train
+    step must not delete the source network's buffers)."""
+    dst_net.params[key] = {k: jnp.array(v, copy=True)
+                           for k, v in src_net.params[key].items()}
+    dst_net.net_state[key] = {k: jnp.array(v, copy=True)
+                              for k, v in src_net.net_state[key].items()}
+
+
 class TransferLearning:
-    """Namespace mirroring the reference's ``TransferLearning.Builder``."""
+    """Namespace mirroring the reference's ``TransferLearning.Builder`` /
+    ``TransferLearning.GraphBuilder``."""
 
     @staticmethod
     def builder(net) -> "TransferLearningBuilder":
         return TransferLearningBuilder(net)
+
+    @staticmethod
+    def graph_builder(net) -> "GraphTransferLearningBuilder":
+        return GraphTransferLearningBuilder(net)
 
 
 class TransferLearningBuilder:
@@ -41,8 +72,8 @@ class TransferLearningBuilder:
         from .multilayer import MultiLayerNetwork
         if not isinstance(net, MultiLayerNetwork):
             raise ValueError(
-                "TransferLearning operates on MultiLayerNetwork; build "
-                "graph surgery with GraphBuilder directly")
+                "TransferLearning.builder operates on MultiLayerNetwork; "
+                "use TransferLearning.graph_builder for ComputationGraph")
         net.init()
         self._src = net
         self._conf = copy.deepcopy(net.conf)
@@ -106,20 +137,11 @@ class TransferLearningBuilder:
         for i, layer in enumerate(kept_layers):
             # preserve freezes inherited from a previous transfer
             layer.frozen = layer.frozen or i <= self._frozen_up_to
-        if self._lr is not None:
-            conf.conf.updater.learning_rate = self._lr
-        if self._updater is not None:
-            conf.conf.updater.updater = self._updater
         # kept layers carry their own finalized updater confs (aliasing
         # with the global conf was broken by deepcopy), so fine-tune
         # overrides must be pushed into each unfrozen kept layer too
-        for layer in kept_layers:
-            if layer.frozen or layer.updater is None:
-                continue
-            if self._lr is not None:
-                layer.updater.learning_rate = self._lr
-            if self._updater is not None:
-                layer.updater.updater = self._updater
+        _apply_fine_tune_overrides(kept_layers, conf.conf.updater,
+                                   self._lr, self._updater)
         added = [copy.deepcopy(l) for l in self._added]
         for layer in added:
             # new layers inherit the (possibly overridden) global defaults
@@ -134,13 +156,110 @@ class TransferLearningBuilder:
             if i < self._keep}
 
         net = MultiLayerNetwork(conf).init()
-        # transfer params + layer state for every retained layer.  COPY,
-        # don't alias: the new net's train step donates its param buffers,
-        # and a shared buffer would be deleted out from under the source
-        # network on the first fine-tune step.
         for i in range(self._keep):
-            net.params[i] = {k: jnp.array(v, copy=True)
-                             for k, v in self._src.params[i].items()}
-            net.net_state[i] = {k: jnp.array(v, copy=True)
-                                for k, v in self._src.net_state[i].items()}
+            _copy_entry(self._src, net, i)
+        # the source's completed pretraining carries over — fit() must not
+        # re-run unsupervised pretraining over the transferred weights
+        net._pretrain_done = self._src._pretrain_done
+        return net
+
+
+class GraphTransferLearningBuilder:
+    """ComputationGraph transfer (reference ``TransferLearning
+    .GraphBuilder``, scoped to the dominant uses): freeze a vertex and
+    all its ancestors as the feature extractor, replace output-layer
+    vertices for a new task, and override fine-tune hyperparameters."""
+
+    def __init__(self, net):
+        from .computation_graph import ComputationGraph
+        if not isinstance(net, ComputationGraph):
+            raise ValueError("graph_builder requires a ComputationGraph")
+        net.init()
+        self._src = net
+        self._conf = copy.deepcopy(net.conf)
+        self._freeze_roots: List[str] = []
+        self._replaced: dict = {}
+        self._lr: Optional[float] = None
+        self._updater: Optional[str] = None
+
+    def fine_tune_learning_rate(self, lr: float
+                                ) -> "GraphTransferLearningBuilder":
+        self._lr = float(lr)
+        return self
+
+    def fine_tune_updater(self, updater: str
+                          ) -> "GraphTransferLearningBuilder":
+        self._updater = updater
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str
+                              ) -> "GraphTransferLearningBuilder":
+        """Freeze the named vertices and every ancestor vertex (reference
+        ``setFeatureExtractor(vertexName)`` semantics)."""
+        unknown = [n for n in vertex_names if n not in self._conf.vertices]
+        if unknown:
+            raise ValueError(f"unknown vertices: {unknown}")
+        self._freeze_roots.extend(vertex_names)
+        return self
+
+    def replace_output_layer(self, vertex_name: str, new_layer
+                             ) -> "GraphTransferLearningBuilder":
+        """Swap the layer config of an existing layer vertex (typically an
+        output head for a new class count); its params re-initialize
+        (reference ``nOutReplace``/``removeVertexAndConnections`` +
+        ``addLayer`` for the head-swap case)."""
+        v = self._conf.vertices.get(vertex_name)
+        if v is None or not hasattr(v, "layer"):
+            raise ValueError(
+                f"{vertex_name!r} is not a layer vertex of this graph")
+        self._replaced[vertex_name] = new_layer
+        return self
+
+    def _ancestors(self, roots: List[str]) -> set:
+        """Roots plus all transitive input vertices (network inputs
+        excluded — they carry no params)."""
+        out, stack = set(), list(roots)
+        while stack:
+            name = stack.pop()
+            if name in out or name not in self._conf.vertices:
+                continue
+            out.add(name)
+            stack.extend(self._conf.vertices[name].inputs or [])
+        return out
+
+    def build(self):
+        from .computation_graph import ComputationGraph
+
+        conf = copy.deepcopy(self._conf)
+        frozen = self._ancestors(self._freeze_roots)
+        overlap = frozen & set(self._replaced)
+        if overlap:
+            raise ValueError(
+                f"vertices both frozen and replaced: {sorted(overlap)}")
+        for name in frozen:
+            v = conf.vertices[name]
+            if hasattr(v, "layer") and v.layer is not None:
+                # preserve freezes inherited from a previous transfer
+                v.layer.frozen = True
+        _apply_fine_tune_overrides(
+            [getattr(v, "layer", None) for v in conf.vertices.values()],
+            conf.conf.updater, self._lr, self._updater)
+        for name, new_layer in self._replaced.items():
+            nl = copy.deepcopy(new_layer)
+            nl.finalize_defaults(conf.conf.layer_defaults())
+            conf.vertices[name].layer = nl
+        if self._replaced and getattr(conf, "input_types", None):
+            # a replacement head given without n_in relies on shape
+            # inference, exactly like the importer/zoo-built source did
+            from .conf.computation_graph import _infer_graph_shapes
+            _infer_graph_shapes(conf)
+
+        net = ComputationGraph(conf).init()
+        # copy params/state for every retained layer vertex (replaced
+        # heads keep their fresh init)
+        for name in self._src.params:
+            if name not in self._replaced:
+                _copy_entry(self._src, net, name)
+        # see the MLN builder: transferred pretraining stays done
+        net._pretrain_done = self._src._pretrain_done
         return net

@@ -517,3 +517,99 @@ def test_graph_score_examples_sums_multiple_outputs():
     s2 = n2.score_examples(MultiDataSet([X], [Y2]),
                            add_regularization_terms=False)
     np.testing.assert_allclose(both, s1 + s2, rtol=1e-6)
+
+
+def test_graph_transfer_learning_freeze_and_head_swap():
+    """Graph transfer: freeze a vertex + ancestors, swap the output head
+    for a new class count, fine-tune; frozen weights stay bitwise fixed
+    and the source graph survives (no shared donated buffers)."""
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    g = (_builder().add_inputs("in")
+         .add_layer("d1", DenseLayer(n_in=4, n_out=8), "in")
+         .add_layer("d2", DenseLayer(n_in=8, n_out=6), "d1")
+         .add_layer("out", OutputLayer(n_in=6, n_out=3), "d2")
+         .set_outputs("out").build())
+    src = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    X = np.float64(rng.randn(60, 4))
+    y3 = rng.randint(0, 3, 60)
+    src.fit(MultiDataSet([X], [np.float64(np.eye(3)[y3])]))
+    src_out_before = np.asarray(src.output(X))
+
+    y2 = (X[:, 0] > 0).astype(int)
+    new = (TransferLearning.graph_builder(src)
+           .fine_tune_learning_rate(0.05)
+           .set_feature_extractor("d1")
+           .replace_output_layer("out", OutputLayer(n_in=6, n_out=2))
+           .build())
+    assert new.vertices["d1"].layer.frozen
+    assert not new.vertices["d2"].layer.frozen
+    assert not new.vertices["out"].layer.frozen
+    assert new.vertices["out"].layer.n_out == 2
+    np.testing.assert_array_equal(np.asarray(new.params["d1"]["W"]),
+                                  np.asarray(src.params["d1"]["W"]))
+
+    w_frozen = np.asarray(new.params["d1"]["W"]).copy()
+    for _ in range(60):
+        new.fit(MultiDataSet([X], [np.float64(np.eye(2)[y2])]))
+    np.testing.assert_array_equal(np.asarray(new.params["d1"]["W"]),
+                                  w_frozen)
+    assert np.asarray(new.output(X)).shape == (60, 2)
+    acc = np.asarray(new.output(X)).argmax(1)
+    assert (acc == y2).mean() > 0.8
+    # source graph unharmed by the fine-tune (deep-copied params)
+    np.testing.assert_allclose(np.asarray(src.output(X)), src_out_before)
+
+
+def test_graph_transfer_validation():
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    g = (_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=5), "in")
+         .add_layer("out", OutputLayer(n_in=5, n_out=2), "d")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    b = TransferLearning.graph_builder(net)
+    with pytest.raises(ValueError, match="unknown vertices"):
+        b.set_feature_extractor("nope")
+    with pytest.raises(ValueError, match="not a layer vertex"):
+        b.replace_output_layer("in", OutputLayer(n_in=5, n_out=2))
+    with pytest.raises(ValueError, match="frozen and replaced"):
+        (TransferLearning.graph_builder(net)
+         .set_feature_extractor("out")
+         .replace_output_layer("out", OutputLayer(n_in=5, n_out=4))
+         .build())
+
+
+def test_graph_transfer_pretrain_flag_and_shape_inference():
+    """Transferred nets keep the source's pretraining-done state, and a
+    replacement head without n_in gets it from shape inference when the
+    source graph was built with input types."""
+    from deeplearning4j_tpu.nn.conf import inputs as _inputs
+    from deeplearning4j_tpu.nn.layers.pretrain import AutoEncoder
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+    g = (_builder().add_inputs("in")
+         .add_layer("ae", AutoEncoder(activation="sigmoid", n_out=5), "in")
+         .add_layer("out", OutputLayer(n_out=3), "ae")
+         .set_input_types(_inputs.feed_forward(4))
+         .set_outputs("out").build())
+    src = ComputationGraph(g).init()
+    rng = np.random.RandomState(0)
+    mds = MultiDataSet([np.float64(rng.rand(16, 4))],
+                       [np.float64(np.eye(3)[rng.randint(0, 3, 16)])])
+    src.pretrain(mds, epochs=1)
+    assert src._pretrain_done
+    new = (TransferLearning.graph_builder(src)
+           .set_feature_extractor("ae")
+           .replace_output_layer("out", OutputLayer(n_out=2))  # no n_in!
+           .build())
+    assert new._pretrain_done                      # flag carried over
+    assert new.vertices["out"].layer.n_in == 5     # inferred
+    w = np.asarray(new.params["ae"]["W"]).copy()
+    new.fit(mds._replace(labels=[np.float64(np.eye(2)[
+        rng.randint(0, 2, 16)])]) if hasattr(mds, "_replace") else
+        MultiDataSet(mds.features,
+                     [np.float64(np.eye(2)[rng.randint(0, 2, 16)])]))
+    np.testing.assert_array_equal(np.asarray(new.params["ae"]["W"]), w)
