@@ -20,11 +20,12 @@ from .datasets.dataset import DataSet, MultiDataSet  # noqa: F401
 from .eval.evaluation import Evaluation  # noqa: F401
 from .utils.model_serializer import (  # noqa: F401
     restore_computation_graph, restore_multi_layer_network, write_model)
+from .nn.transfer import TransferLearning  # noqa: F401
 
 __all__ = [
     "NeuralNetConfiguration", "MultiLayerConfiguration",
     "ComputationGraphConfiguration", "MultiLayerNetwork",
     "ComputationGraph", "DataSet", "MultiDataSet", "Evaluation",
     "write_model", "restore_multi_layer_network",
-    "restore_computation_graph",
+    "restore_computation_graph", "TransferLearning",
 ]
