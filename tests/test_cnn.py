@@ -248,3 +248,41 @@ def test_batch_norm_scalar_gamma_gradient_shape():
     num = (loss(jnp.asarray(1.0 + eps), jnp.asarray(0.5))
            - loss(jnp.asarray(1.0 - eps), jnp.asarray(0.5))) / (2 * eps)
     np.testing.assert_allclose(float(dg), float(num), rtol=1e-2)
+
+
+def test_gradcheck_pointwise_conv_dot_general_path():
+    """1x1 unit-stride convs lower as dot_general (MXU weight grads);
+    their analytic gradients must match numerics like any conv."""
+    net = _cnn_net([
+        ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="tanh"),
+        ConvolutionLayer(n_out=3, kernel_size=(1, 1), activation="tanh"),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=80)
+
+
+def test_gradcheck_same_mode_strided_conv():
+    """ConvolutionMode.Same with stride 2 (the ResNet downsample shape)."""
+    net = _cnn_net([
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(2, 2),
+                         convolution_mode="same", activation="tanh"),
+        OutputLayer(n_out=3),
+    ])
+    assert check_gradients(net, _img_ds(), print_results=True, subset=80)
+
+
+def test_pointwise_conv_matches_general_conv():
+    """The dot_general fast path must equal conv_general_dilated bitwise
+    for 1x1 kernels (fwd), covering both mode spellings."""
+    from deeplearning4j_tpu.ops import convolution as conv_ops
+    import jax.numpy as jnp
+    from jax import lax
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 5, 5, 3).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 1, 3, 4).astype(np.float32))
+    for mode in ("truncate", "same"):
+        fast = conv_ops.conv2d(x, k, (1, 1), (0, 0), mode)
+        ref = lax.conv_general_dilated(
+            x, k, (1, 1), "SAME" if mode == "same" else [(0, 0), (0, 0)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(ref))
