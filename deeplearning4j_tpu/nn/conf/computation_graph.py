@@ -343,6 +343,16 @@ class ComputationGraphConfiguration:
         import json
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """Reference ``ComputationGraphConfiguration.toYaml``."""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
 
 class GraphBuilder:
     """Reference ``ComputationGraphConfiguration.GraphBuilder`` fluent API."""

@@ -236,3 +236,35 @@ def test_every_registered_conf_type_round_trips():
     # so silent de-registration is caught too
     assert not skipped, f"conf types that failed to round-trip: {skipped}"
     assert checked == len(serde._REGISTRY) >= 54, (checked, skipped)
+
+
+def test_yaml_round_trip_mln_and_graph():
+    """Reference toYaml/fromYaml (MultiLayerConfiguration.java:79-124):
+    YAML round trip must reproduce the exact config dict, including a
+    graph with vertices and preprocessors."""
+    from deeplearning4j_tpu import (ComputationGraphConfiguration,
+                                    MultiLayerConfiguration,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import inputs as _inputs
+    from deeplearning4j_tpu.nn.conf.computation_graph import MergeVertex
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+    lb = (NeuralNetConfiguration.builder().seed(9).updater("adam")
+          .learning_rate(3e-3).weight_init("xavier").list())
+    lb.layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+    lb.layer(OutputLayer(n_out=2))
+    lb.set_input_type(_inputs.convolutional(8, 8, 1))
+    conf = lb.build()
+    restored = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert restored.to_dict() == conf.to_dict()
+
+    g = (NeuralNetConfiguration.builder().seed(1).graph_builder()
+         .add_inputs("a", "b")
+         .add_layer("d1", DenseLayer(n_in=3, n_out=4), "a")
+         .add_layer("d2", DenseLayer(n_in=2, n_out=4), "b")
+         .add_vertex("m", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_in=8, n_out=2), "m")
+         .set_outputs("out").build())
+    g2 = ComputationGraphConfiguration.from_yaml(g.to_yaml())
+    assert g2.to_dict() == g.to_dict()
