@@ -238,3 +238,25 @@ def test_lstm_model_serializer_roundtrip(tmp_path):
     restored = restore_multi_layer_network(path)
     np.testing.assert_allclose(net.output(ds.features),
                                restored.output(ds.features), rtol=1e-6)
+
+
+# ------------------------- masked global pooling (GlobalPoolingMaskingTests)
+
+@pytest.mark.parametrize("kind", ["max", "avg", "sum", "pnorm"])
+def test_masked_global_pooling_equals_truncated_sequence(kind):
+    """Reference ``GlobalPoolingMaskingTests``: pooling a padded+masked
+    sequence must equal pooling the unpadded sequence."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+    rng = np.random.RandomState(0)
+    T, t_real, f = 7, 4, 5
+    x_real = rng.randn(3, t_real, f)
+    x_pad = np.concatenate(
+        [x_real, 99.0 * np.ones((3, T - t_real, f))], axis=1)
+    mask = np.zeros((3, T)); mask[:, :t_real] = 1.0
+    layer = GlobalPoolingLayer(pooling_type=kind)
+    out_pad, _ = layer.forward({}, {}, jnp.asarray(x_pad), train=False,
+                               mask=jnp.asarray(mask))
+    out_real, _ = layer.forward({}, {}, jnp.asarray(x_real), train=False)
+    np.testing.assert_allclose(np.asarray(out_pad), np.asarray(out_real),
+                               rtol=1e-6)
