@@ -294,6 +294,9 @@ class MultiLayerNetwork:
         if self.conf.conf.num_iterations != 1:
             raise ValueError("fit_scan runs one update per batch; "
                              "num_iterations > 1 must use fit()")
+        if self._solver is not None:
+            raise ValueError("fit_scan supports the SGD path only; this "
+                             "config uses a line-search solver")
 
         def stack_masks(get):
             present = [get(b) is not None for b in batches]

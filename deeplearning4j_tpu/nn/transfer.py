@@ -212,6 +212,14 @@ class GraphTransferLearningBuilder:
         if v is None or not hasattr(v, "layer"):
             raise ValueError(
                 f"{vertex_name!r} is not a layer vertex of this graph")
+        if vertex_name not in self._conf.network_outputs:
+            # replacing a mid-graph vertex would copy old-shaped params of
+            # downstream kept vertices into the re-inferred net and fail
+            # later with an opaque shape error
+            raise ValueError(
+                f"{vertex_name!r} is not a network output of this graph "
+                f"(outputs: {list(self._conf.network_outputs)}); "
+                "replace_output_layer only swaps output heads")
         self._replaced[vertex_name] = new_layer
         return self
 

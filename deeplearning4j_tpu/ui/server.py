@@ -516,7 +516,8 @@ class UIServer:
                     "hardware": info,
                     "memory_vs_iter": [
                         [u.data["iteration"], u.data["memory_rss_mb"]]
-                        for u in ups if "memory_rss_mb" in u.data],
+                        for u in ups
+                        if "memory_rss_mb" in u.data and "iteration" in u.data],
                 }
         return {"workers": workers}
 
