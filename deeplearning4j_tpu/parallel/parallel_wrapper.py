@@ -67,6 +67,7 @@ class ParallelWrapper:
             ("data",))
         self.listeners: List[Any] = []
         self._worker_ustate = None  # stacked (workers, ...) across rounds
+        self.skipped_tail_batches = 0  # stragglers left unfitted (ref parity)
 
     # -- builder-style API (reference ParallelWrapper.Builder) -------------
     class Builder:
@@ -179,6 +180,8 @@ class ParallelWrapper:
         net = self.model
         net.init()
         k, w = self.averaging_frequency, self.workers
+        rounds_run = 0
+        self.skipped_tail_batches = 0
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
@@ -187,15 +190,25 @@ class ParallelWrapper:
                 pending.append(ds)
                 if len(pending) == k * w:
                     self._run_round(pending)
+                    rounds_run += 1
                     pending = []
-            if pending:
-                # Tail: pad the round by cycling through the straggler
-                # batches (the reference simply leaves stragglers to the next
-                # fit call; padding keeps shapes static for XLA)
-                n = len(pending)
-                for i in range(k * w - n):
-                    pending.append(pending[i % n])
-                self._run_round(pending)
+            # Tail: an incomplete round is left unfitted, matching the
+            # reference exactly (``ParallelWrapper.java:150-165`` dispatches
+            # only full worker groups; stragglers never reach a Trainer).
+            # Padding the round with duplicated batches would give tail
+            # examples extra gradient weight; a smaller round would force an
+            # XLA recompile for one step.  Stragglers are counted so callers
+            # can size iterators to workers*averaging_frequency.
+            self.skipped_tail_batches += len(pending)
+        if rounds_run == 0:
+            import warnings
+            warnings.warn(
+                f"ParallelWrapper.fit trained NOTHING: the iterator yielded "
+                f"fewer than workers*averaging_frequency = {w * k} batches "
+                f"per epoch ({self.skipped_tail_batches} straggler batches "
+                f"dropped across {epochs} epoch(s)). Use a bigger dataset, "
+                f"fewer workers, or a smaller averaging_frequency.",
+                stacklevel=2)
         return self
 
     def _run_round(self, batches: List[DataSet]) -> None:

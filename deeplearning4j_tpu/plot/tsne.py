@@ -9,13 +9,16 @@ momentum + per-dimension gains, and ``saveCoordinates`` output.
 TPU-first redesign: the reference approximates the repulsive force with a
 Barnes-Hut quadtree/sptree (theta > 0) because exact t-SNE is O(N²) on a
 CPU.  On TPU the exact N² affinity and gradient are a handful of MXU
-matmuls — faster than any host-side tree walk for the N this API is used
-at (embedding visualisations, ≤ tens of thousands of points) — so
-``theta`` is accepted for surface parity but the computation is always
-exact.  The entire optimisation (sigma bisection, P matrix, every
-gradient iteration with momentum/gains/exaggeration) runs in ONE jitted
-``lax.fori_loop`` program; nothing crosses the host boundary until the
-final coordinates.
+matmuls — faster than any host-side tree walk at moderate N — so below
+``tile_threshold`` points the computation is exact and ``theta`` is
+accepted for surface parity only.  Above the threshold, materialising
+(N, N) would blow device memory, so the run switches to a tiled program:
+the attractive term sparsifies P to the 3·perplexity nearest neighbours
+(the same sparsification Barnes-Hut t-SNE applies to P) and the repulsive
+term stays EXACT but is computed in (block, N) tiles.  Either way the
+entire optimisation (sigma bisection, affinities, every gradient
+iteration with momentum/gains/exaggeration) runs as ONE jitted XLA
+program; nothing crosses the host boundary until the final coordinates.
 """
 
 from __future__ import annotations
@@ -30,31 +33,47 @@ import numpy as np
 Array = jax.Array
 
 
-def _sq_dists(x: Array) -> Array:
+def _block_sq_dists(xb: Array, x: Array) -> Array:
+    """(B, N) squared distances from a row block to all points."""
+    n2b = jnp.sum(xb * xb, axis=1)
     n2 = jnp.sum(x * x, axis=1)
-    d = n2[:, None] + n2[None, :] - 2.0 * x @ x.T
+    d = n2b[:, None] + n2[None, :] - 2.0 * xb @ x.T
     return jnp.maximum(d, 0.0)
 
 
-def _cond_probs(d_row: Array, beta: Array, i_mask: Array) -> Array:
-    """p_{j|i} for one precision beta, self-probability masked to 0."""
-    p = jnp.exp(-d_row * beta) * i_mask
-    return p / jnp.maximum(p.sum(), 1e-12)
+def _sq_dists(x: Array) -> Array:
+    return _block_sq_dists(x, x)
+
+
+def _opt_step(it, y, vel, gains, g, learning_rate, switch_momentum):
+    """One shared gradient-descent step: momentum switch + per-dimension
+    gains (reference BarnesHutTsne gains update) — used by both the exact
+    and tiled paths so they cannot drift apart."""
+    momentum = jnp.where(it < switch_momentum, 0.5, 0.8)
+    same_sign = jnp.sign(g) == jnp.sign(vel)
+    gains = jnp.where(same_sign, gains * 0.8, gains + 0.2)
+    gains = jnp.maximum(gains, 0.01)
+    vel = momentum * vel - learning_rate * gains * g
+    return y + vel, vel, gains
 
 
 def _perplexity_search(d: Array, target_entropy: float,
                        iters: int = 50) -> Array:
     """Vectorised per-point bisection on beta = 1/(2 sigma^2) so each
     row's Shannon entropy matches log(perplexity) (reference
-    ``computeGaussianPerplexity`` binary search, all rows at once)."""
+    ``computeGaussianPerplexity`` binary search, all rows at once).
+
+    ``d`` is (N, M): dense N² distances OR (N, k) neighbour distances.
+    Entries to exclude (self, padding) must be pre-set to +inf — they get
+    zero probability for every beta > 0."""
     n = d.shape[0]
-    eye_mask = 1.0 - jnp.eye(n, dtype=d.dtype)
+    d_safe = jnp.where(jnp.isfinite(d), d, 0.0)   # inf*0 would NaN the sum
 
     def entropy(beta):
-        p = jnp.exp(-d * beta[:, None]) * eye_mask
+        p = jnp.exp(-d * beta[:, None])
         psum = jnp.maximum(p.sum(1), 1e-12)
         # H = log(sum) + beta * sum(d * p)/sum(p)
-        return jnp.log(psum) + beta * jnp.sum(d * p, 1) / psum
+        return jnp.log(psum) + beta * jnp.sum(d_safe * p, 1) / psum
 
     def body(_, state):
         beta, lo, hi = state
@@ -85,10 +104,10 @@ def _tsne_run(x: Array, key: Array, n_dims: int, perplexity,
               stop_lying_iteration, exaggeration):
     """Whole t-SNE optimisation as one XLA program."""
     n = x.shape[0]
-    d = _sq_dists(x)
-    beta = _perplexity_search(d, jnp.log(perplexity))
     eye_mask = 1.0 - jnp.eye(n, dtype=x.dtype)
-    p = jnp.exp(-d * beta[:, None]) * eye_mask
+    d = jnp.where(eye_mask > 0, _sq_dists(x), jnp.inf)
+    beta = _perplexity_search(d, jnp.log(perplexity))
+    p = jnp.exp(-jnp.where(eye_mask > 0, d, 0.0) * beta[:, None]) * eye_mask
     p = p / jnp.maximum(p.sum(1, keepdims=True), 1e-12)
     p = (p + p.T) / (2.0 * n)                      # symmetrize
     p = jnp.maximum(p, 1e-12)
@@ -108,16 +127,11 @@ def _tsne_run(x: Array, key: Array, n_dims: int, perplexity,
 
     def body(it, state):
         y, vel, gains = state
-        momentum = jnp.where(it < switch_momentum, 0.5, 0.8)
         lying = it < stop_lying_iteration
         p_eff = jnp.where(lying, p * exaggeration, p)
         g, _ = grad_kl(y, p_eff)
-        # per-dimension gains (reference BarnesHutTsne gains update)
-        same_sign = jnp.sign(g) == jnp.sign(vel)
-        gains = jnp.where(same_sign, gains * 0.8, gains + 0.2)
-        gains = jnp.maximum(gains, 0.01)
-        vel = momentum * vel - learning_rate * gains * g
-        y = y + vel
+        y, vel, gains = _opt_step(it, y, vel, gains, g, learning_rate,
+                                  switch_momentum)
         y = y - y.mean(0, keepdims=True)           # recenter
         return y, vel, gains
 
@@ -128,16 +142,126 @@ def _tsne_run(x: Array, key: Array, n_dims: int, perplexity,
     return y, kl
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _knn(x: Array, mask: Array, k: int, block: int):
+    """Blocked k-nearest-neighbour pass: (N, k) distances + indices without
+    ever materialising (N, N).  Self and padding columns are pushed to +inf
+    so they never make the top-k."""
+    n = x.shape[0]
+    idx_all = jnp.arange(n)
+    inf = jnp.asarray(jnp.inf, x.dtype)
+
+    def one_block(start):
+        rows = start + jnp.arange(block)
+        d = _block_sq_dists(jax.lax.dynamic_slice_in_dim(x, start, block), x)
+        d = jnp.where(idx_all[None, :] == rows[:, None], inf, d)
+        d = jnp.where(mask[None, :] > 0, d, inf)
+        neg_d, idx = jax.lax.top_k(-d, k)
+        return -neg_d, idx
+
+    starts = jnp.arange(0, n, block)
+    dists, idxs = jax.lax.map(one_block, starts)
+    return dists.reshape(n, k), idxs.reshape(n, k)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 6, 11, 12))
+def _tsne_run_tiled(x: Array, mask: Array, key: Array, n_dims: int,
+                    n_real, perplexity, max_iter: int, learning_rate,
+                    switch_momentum, stop_lying_iteration, exaggeration,
+                    k: int, block: int):
+    """Large-N t-SNE: kNN-sparse attractive term (k = 3*perplexity
+    neighbours — the same sparsification Barnes-Hut t-SNE uses for P, see
+    reference ``BarnesHutTsne.java:848`` / van der Maaten 2014) plus an
+    EXACT repulsive term computed in (block, N) tiles.  Peak device memory
+    is O(N*k + block*N) instead of O(N²); the whole optimisation is still
+    one XLA program."""
+    n = x.shape[0]
+    knn_d, knn_idx = _knn(x, mask, k, block)
+    beta = _perplexity_search(knn_d, jnp.log(perplexity))
+    p = jnp.exp(-knn_d * beta[:, None]) * mask[:, None]
+    p = p / jnp.maximum(p.sum(1, keepdims=True), 1e-12)
+    p = p * mask[:, None]                     # pad rows contribute nothing
+    # Symmetrised sparse P is handled edge-wise: every directed edge
+    # (i -> knn_idx[i,l], p[i,l]) contributes p/2N to BOTH endpoints'
+    # attractive force, which is exactly (P + P^T)/2N without building the
+    # union sparsity pattern.
+    src = jnp.repeat(jnp.arange(n), k)
+    dst = knn_idx.reshape(-1)
+    pval = p.reshape(-1) / (2.0 * n_real)
+
+    y0 = jax.random.normal(key, (n, n_dims), x.dtype) * 1e-2
+    idx_all = jnp.arange(n)
+
+    def repulsion(y):
+        """Tiled exact repulsion: returns (sum_j num_ij^2 (y_i - y_j), Z)."""
+        def one_block(start):
+            rows = start + jnp.arange(block)
+            yb = jax.lax.dynamic_slice_in_dim(y, start, block)
+            dy = _block_sq_dists(yb, y)
+            num = 1.0 / (1.0 + dy)
+            num = jnp.where(idx_all[None, :] == rows[:, None], 0.0, num)
+            num = num * mask[None, :] * mask[rows][:, None]
+            z_part = num.sum()
+            n2 = num * num
+            rep = n2.sum(1, keepdims=True) * yb - n2 @ y
+            return rep, z_part
+
+        starts = jnp.arange(0, n, block)
+        reps, z_parts = jax.lax.map(one_block, starts)
+        return reps.reshape(n, n_dims), z_parts.sum()
+
+    def grad_kl(y, exagger):
+        ys, yd = y[src], y[dst]
+        w = 1.0 / (1.0 + jnp.sum((ys - yd) ** 2, axis=1))
+        pe = pval * exagger
+        attr_edge = (pe * w)[:, None] * (ys - yd)
+        attr = (jnp.zeros_like(y).at[src].add(attr_edge)
+                .at[dst].add(-attr_edge))
+        rep, z = repulsion(y)
+        g = 4.0 * (attr - rep / jnp.maximum(z, 1e-12))
+        # KL over the sparse support (standard BH-t-SNE reporting).  The
+        # exact path sums p_sym log(p_sym/q) over ALL ordered pairs with
+        # total P mass 1; here each undirected edge is (usually) seen from
+        # both endpoints with half the symmetrized mass, so 2*pval is the
+        # p_sym estimate per directed edge and the total mass is ~1 —
+        # keeping kl_divergence on the same scale across tile_threshold.
+        q = jnp.maximum(w / jnp.maximum(z, 1e-12), 1e-12)
+        p_sym = 2.0 * pval
+        kl = jnp.sum(p_sym * jnp.log(jnp.maximum(p_sym, 1e-12) / q))
+        return g, kl
+
+    def body(it, state):
+        y, vel, gains = state
+        exagger = jnp.where(it < stop_lying_iteration, exaggeration, 1.0)
+        g, _ = grad_kl(y, exagger)
+        y, vel, gains = _opt_step(it, y, vel, gains, g, learning_rate,
+                                  switch_momentum)
+        mean = (jnp.sum(y * mask[:, None], 0, keepdims=True) / n_real)
+        y = (y - mean) * mask[:, None]
+        return y, vel, gains
+
+    y, _, _ = jax.lax.fori_loop(
+        0, max_iter, body, (y0 * mask[:, None], jnp.zeros_like(y0),
+                            jnp.ones_like(y0)))
+    _, kl = grad_kl(y, jnp.asarray(1.0, x.dtype))
+    return y, kl
+
+
 class Tsne:
-    """Reference ``BarnesHutTsne`` Builder surface; exact computation
-    (``theta`` accepted but ignored — see module docstring)."""
+    """Reference ``BarnesHutTsne`` Builder surface.  Below
+    ``tile_threshold`` points the computation is exact and one-shot (see
+    module docstring); above it, it switches to the tiled path
+    (``_tsne_run_tiled``) so device memory stays O(N*k + block*N) instead
+    of the exact path's O(N²) — the TPU-native answer to the reference's
+    Barnes-Hut tree."""
 
     def __init__(self, n_dims: int = 2, perplexity: float = 30.0,
                  theta: float = 0.5, learning_rate: float = 200.0,
                  max_iter: int = 1000, switch_momentum_iteration: int = 250,
                  stop_lying_iteration: int = 250,
                  exaggeration: float = 12.0, seed: int = 42,
-                 normalize: bool = True):
+                 normalize: bool = True, tile_threshold: int = 4096,
+                 block_size: int = 1024):
         self.n_dims = n_dims
         self.perplexity = perplexity
         self.theta = theta
@@ -148,6 +272,8 @@ class Tsne:
         self.exaggeration = exaggeration
         self.seed = seed
         self.normalize = normalize
+        self.tile_threshold = int(tile_threshold)
+        self.block_size = int(block_size)
         self.coords: Optional[np.ndarray] = None
         self.kl_divergence: float = float("nan")
 
@@ -188,14 +314,33 @@ class Tsne:
                 " (need n-1 >= 3*perplexity)")
         if self.normalize:
             x = (x - x.mean(0)) / np.maximum(x.std(0), 1e-12)
-        y, kl = _tsne_run(
-            jnp.asarray(x), jax.random.PRNGKey(self.seed), self.n_dims,
-            jnp.float32(self.perplexity), int(self.max_iter),
-            jnp.float32(self.learning_rate),
-            jnp.int32(self.switch_momentum_iteration),
-            jnp.int32(self.stop_lying_iteration),
-            jnp.float32(self.exaggeration))
-        self.coords = np.asarray(y)
+        n = x.shape[0]
+        if n <= self.tile_threshold:
+            y, kl = _tsne_run(
+                jnp.asarray(x), jax.random.PRNGKey(self.seed), self.n_dims,
+                jnp.float32(self.perplexity), int(self.max_iter),
+                jnp.float32(self.learning_rate),
+                jnp.int32(self.switch_momentum_iteration),
+                jnp.int32(self.stop_lying_iteration),
+                jnp.float32(self.exaggeration))
+            self.coords = np.asarray(y)
+        else:
+            block = min(self.block_size, n)
+            n_pad = ((n + block - 1) // block) * block
+            xp = np.concatenate(
+                [x, np.zeros((n_pad - n, x.shape[1]), x.dtype)])
+            mask = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(n_pad - n, np.float32)])
+            k = min(int(3 * self.perplexity), n - 1)
+            y, kl = _tsne_run_tiled(
+                jnp.asarray(xp), jnp.asarray(mask),
+                jax.random.PRNGKey(self.seed), self.n_dims,
+                jnp.float32(n), jnp.float32(self.perplexity),
+                int(self.max_iter), jnp.float32(self.learning_rate),
+                jnp.int32(self.switch_momentum_iteration),
+                jnp.int32(self.stop_lying_iteration),
+                jnp.float32(self.exaggeration), k, block)
+            self.coords = np.asarray(y)[:n]
         self.kl_divergence = float(kl)
         return self
 

@@ -240,3 +240,27 @@ def test_parallel_fit_serialize_resume_chain(tmp_path):
     np.testing.assert_allclose(np.asarray(again.get_flat_params()),
                                np.asarray(net.get_flat_params()),
                                atol=1e-5)
+
+
+def test_tail_stragglers_left_unfitted_not_double_counted():
+    """An incomplete final round is skipped, matching the reference
+    (``ParallelWrapper.java:150-165`` only dispatches full worker groups).
+    Padding by cycling the stragglers would give tail examples extra
+    gradient weight — assert params equal a run over the full rounds only."""
+    w = 4
+    batches = _batches(w + 2, seed=3)  # one full round + 2 stragglers
+
+    tail_net = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper(tail_net, workers=w, averaging_frequency=1)
+    pw.fit(batches)
+    assert pw.skipped_tail_batches == 2
+
+    full_net = MultiLayerNetwork(_conf()).init()
+    pw_full = ParallelWrapper(full_net, workers=w, averaging_frequency=1)
+    pw_full.fit(batches[:w])
+    assert pw_full.skipped_tail_batches == 0
+
+    np.testing.assert_allclose(np.asarray(tail_net.get_flat_params()),
+                               np.asarray(full_net.get_flat_params()),
+                               rtol=1e-12)
+    assert tail_net.iteration == full_net.iteration
