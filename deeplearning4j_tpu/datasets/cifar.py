@@ -42,7 +42,15 @@ def _read_cifar_bin(path: str, max_records: Optional[int] = None
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Parse one CIFAR-10 binary batch file: records of
     ``[label u8][3072 x u8 pixels, planar RGB]`` (the layout
-    ``CifarDataFetcher`` reads)."""
+    ``CifarDataFetcher`` reads).  Decodes natively (dataloader.cc) when
+    the C++ tier is available."""
+    from .native_io import native_module
+    native = native_module()
+    if native is not None:
+        imgs, labels = native.cifar_decode(path)
+        if max_records is not None:
+            imgs, labels = imgs[:max_records], labels[:max_records]
+        return imgs, labels.astype(np.int64)
     raw = np.fromfile(path, dtype=np.uint8)
     rec = 1 + CHANNELS * HEIGHT * WIDTH
     n = raw.size // rec

@@ -151,16 +151,78 @@ class MultipleEpochsIterator(DataSetIterator):
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference
-    ``AsyncDataSetIterator``: queue capacity 2, daemon thread)."""
+    ``AsyncDataSetIterator``: queue capacity 2, daemon thread).
+
+    When the C++ tier is present (``native/dataloader.cc``) and the
+    underlying iterator is a plain shuffled in-memory ``ListDataSetIterator``
+    (dense float32, no masks, no preprocessor, batch divides n), the
+    prefetch runs on a NATIVE thread via the pthread ring buffer — the
+    per-epoch shuffle and batch gather never touch the GIL.  Anything
+    else falls back to the Python thread, same contract (the reference's
+    reflective-helper-with-fallback posture)."""
 
     _END = object()
 
-    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2,
+                 use_native: Optional[bool] = None):
         self._under = underlying
         self._size = queue_size
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._native_pf = None
+        self._native_left = 0
+        self._ring_epoch = 0
+        self._use_native_req = True if use_native is None else use_native
+        self.native = self._use_native_req and self._native_eligible()
+
+    def _native_eligible(self) -> bool:
+        from .native_io import native_module
+        if native_module() is None:
+            return False
+        u = self._under
+        # Exact ListDataSetIterator semantics only: a subclass that
+        # overrides iteration (per-batch augmentation etc.) must keep the
+        # Python path or the override would be silently bypassed.
+        if not isinstance(u, ListDataSetIterator):
+            return False
+        if (type(u).__next__ is not ListDataSetIterator.__next__
+                or type(u).reset is not ListDataSetIterator.reset):
+            return False
+        ds = u._ds
+        if (ds.features_mask is not None or ds.labels_mask is not None
+                or ds.features is None or ds.labels is None):
+            return False
+        # float32 only — the ring stores f32, and silently downcasting
+        # f64 data would make results depend on whether the lib built
+        if (np.asarray(ds.features).dtype != np.float32
+                or np.asarray(ds.labels).dtype != np.float32):
+            return False
+        if not u._shuffle or u.get_preprocessor() is not None:
+            return False
+        n = ds.num_examples()
+        # the ring drops the tail; only take over when there is none
+        return n % u._batch == 0
+
+    def _batches_per_epoch(self) -> int:
+        return self._under._ds.num_examples() // self._under._batch
+
+    def _native_next(self) -> DataSet:
+        from .native_io import native_module
+        if self._native_pf is None:
+            u = self._under
+            self._native_pf = native_module().NativePrefetcher(
+                np.asarray(u._ds.features, np.float32),
+                np.asarray(u._ds.labels, np.float32),
+                batch=u._batch, capacity=max(2, self._size),
+                seed=u._seed + self._ring_epoch)
+            if self._native_left <= 0:
+                self._native_left = self._batches_per_epoch()
+        if self._native_left <= 0:
+            raise StopIteration
+        self._native_left -= 1
+        feats, labels = self._native_pf.next()
+        return self._pre(DataSet(feats, labels))
 
     def _worker(self) -> None:
         try:
@@ -176,6 +238,24 @@ class AsyncDataSetIterator(DataSetIterator):
             self._queue.put(self._END)
 
     def reset(self) -> None:
+        # conditions can change between epochs (preprocessor attached,
+        # dataset swapped) — re-evaluate which path serves the next epoch
+        was_native = self.native
+        self.native = self._use_native_req and self._native_eligible()
+        if was_native and not self.native:
+            self.close()
+            self._native_left = 0
+        if self.native:
+            full = self._batches_per_epoch()
+            if self._native_pf is not None and self._native_left not in (
+                    0, full):
+                # mid-epoch reset: the ring is mid-permutation, so its
+                # leftover batches would straddle two permutations —
+                # rebuild it so the new epoch is one clean cover
+                self.close()
+                self._ring_epoch += 1
+            self._native_left = full
+            return
         if self._thread is not None and self._thread.is_alive():
             # Drain so the producer can exit, then join.
             while self._queue.get() is not self._END:
@@ -190,11 +270,18 @@ class AsyncDataSetIterator(DataSetIterator):
     def batch(self) -> int:
         return self._under.batch()
 
+    def close(self) -> None:
+        if self._native_pf is not None:
+            self._native_pf.close()
+            self._native_pf = None
+
     def __iter__(self) -> Iterator[DataSet]:
         self.reset()
         return self
 
     def __next__(self) -> DataSet:
+        if self.native:
+            return self._native_next()
         if self._thread is None:
             self.reset()
         item = self._queue.get()

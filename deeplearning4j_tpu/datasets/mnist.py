@@ -103,6 +103,25 @@ def _read_idx(path: str) -> np.ndarray:
         raise ValueError(f"Bad IDX magic {magic} in {path}")
 
 
+def _decode_idx_images(path: str, num: int) -> np.ndarray:
+    """(n, rows*cols) float32 in [0,1]: native decoder when the C++ tier
+    is available and the file is raw IDX, Python reader otherwise."""
+    from .native_io import native_module
+    native = native_module()
+    if native is not None and not path.endswith(".gz"):
+        dec = native.idx_decode(path, normalize=True)
+        return dec[:num].reshape(min(num, dec.shape[0]), -1)
+    return _read_idx(path)[:num].astype(np.float32) / 255.0
+
+
+def _decode_idx_labels(path: str, num: int) -> np.ndarray:
+    from .native_io import native_module
+    native = native_module()
+    if native is not None and not path.endswith(".gz"):
+        return native.idx_decode(path, normalize=False)[:num].astype(np.int64)
+    return _read_idx(path)[:num]
+
+
 def _load_real(data_dir: str, train: bool,
                num: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     stem = "train" if train else "t10k"
@@ -112,8 +131,8 @@ def _load_real(data_dir: str, train: bool,
         img_path = os.path.join(data_dir, img_name)
         lbl_path = os.path.join(data_dir, lbl_name)
         if os.path.exists(img_path) and os.path.exists(lbl_path):
-            images = _read_idx(img_path)[:num].astype(np.float32) / 255.0
-            raw = _read_idx(lbl_path)[:num]
+            images = _decode_idx_images(img_path, num)
+            raw = _decode_idx_labels(lbl_path, num)
             labels = np.eye(10, dtype=np.float32)[raw]
             return images, labels
     return None

@@ -1,0 +1,39 @@
+"""Gateway to the native (C++) data tier.
+
+The reference's ETL bottoms out in native code (JavaCPP-wrapped readers;
+SURVEY.md §2.11); here ``native/dataloader.cc`` plays that role.  Product
+code asks this module for the native bindings and silently falls back to
+the pure-Python readers when the shared library can't build (no g++ /
+header) or when ``DL4J_TPU_NATIVE=0`` disables it — the same posture as
+the reference's reflective cuDNN-helper load with an ND4J fallback
+(``ConvolutionLayer.java:69-76``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_native = None
+_checked = False
+
+
+def native_module() -> Optional[object]:
+    """The ``nativeops`` module with a built+loaded shared library, or
+    ``None`` when unavailable/disabled.  Probes once per process."""
+    global _native, _checked
+    if os.environ.get("DL4J_TPU_NATIVE", "1") == "0":
+        return None
+    if not _checked:
+        _checked = True
+        try:
+            from .. import nativeops
+            nativeops.load_native()
+            _native = nativeops
+        except Exception:
+            _native = None
+    return _native
+
+
+def native_available() -> bool:
+    return native_module() is not None

@@ -120,8 +120,72 @@ def load_native() -> ctypes.CDLL:
         ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
         ctypes.c_char_p, ctypes.c_int]
 
+    lib.dl4j_pjrt_compile_cached.restype = ctypes.c_int64
+    lib.dl4j_pjrt_compile_cached.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int]
+    lib.dl4j_pjrt_cache_stats.restype = ctypes.c_int
+    lib.dl4j_pjrt_cache_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.dl4j_pjrt_cache_clear.restype = ctypes.c_int64
+    lib.dl4j_pjrt_cache_clear.argtypes = [ctypes.c_void_p]
+    lib.dl4j_pjrt_exec_num_outputs.restype = ctypes.c_int
+    lib.dl4j_pjrt_exec_num_outputs.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int64]
+    lib.dl4j_pjrt_exec_output_info.restype = ctypes.c_int
+    lib.dl4j_pjrt_exec_output_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_int]
+    lib.dl4j_pjrt_dtype_code.restype = ctypes.c_int
+    lib.dl4j_pjrt_dtype_code.argtypes = [ctypes.c_char_p]
+    lib.dl4j_pjrt_execute.restype = ctypes.c_int
+    lib.dl4j_pjrt_execute.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.dl4j_pjrt_buffer_from_host.restype = ctypes.c_int64
+    lib.dl4j_pjrt_buffer_from_host.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.dl4j_pjrt_buffer_free.restype = ctypes.c_int
+    lib.dl4j_pjrt_buffer_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dl4j_pjrt_execute_mixed.restype = ctypes.c_int
+    lib.dl4j_pjrt_execute_mixed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+
     _lib = lib
     return lib
+
+
+def _np_dtype_name(dt: "np.dtype") -> str:
+    """Numpy (incl. ml_dtypes.bfloat16) dtype → shim dtype-name string."""
+    name = np.dtype(dt).name
+    return {"bool": "pred"}.get(name, name)
+
+
+def _name_to_np(name: str):
+    """Shim dtype-name → numpy dtype (bf16 via ml_dtypes)."""
+    if name in ("bf16", "bfloat16"):
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if name in ("pred", "bool"):
+        return np.dtype(np.bool_)
+    short = {"f16": "float16", "f32": "float32", "f64": "float64",
+             "s8": "int8", "s16": "int16", "s32": "int32", "s64": "int64",
+             "u8": "uint8", "u16": "uint16", "u32": "uint32",
+             "u64": "uint64"}
+    return np.dtype(short.get(name, name))
 
 
 def _fptr(a: np.ndarray):
@@ -310,6 +374,168 @@ class PjrtClient:
             return co.SerializeAsString()
         except Exception:
             return b""
+
+    # -------------------------------------------------- cached typed path
+    def _dtype_codes(self):
+        if not hasattr(self, "_codes"):
+            names = ["pred", "s8", "s16", "s32", "s64", "u8", "u16", "u32",
+                     "u64", "f16", "f32", "f64", "bf16"]
+            self._codes = {n: self._lib.dl4j_pjrt_dtype_code(n.encode())
+                           for n in names}
+            self._code_to_name = {v: k for k, v in self._codes.items()}
+            # the shim also answers to numpy-style long names
+            for long in ["bool", "int8", "int16", "int32", "int64",
+                         "uint8", "uint16", "uint32", "uint64", "float16",
+                         "float32", "float64", "bfloat16"]:
+                self._codes[long] = self._lib.dl4j_pjrt_dtype_code(
+                    long.encode())
+        return self._codes
+
+    def compile_cached(self, mlir: str,
+                       compile_options: Optional[bytes] = None
+                       ) -> Tuple[int, bool]:
+        """Compile a StableHLO module or fetch it from the C++ executable
+        cache (key: program-text hash — shapes/dtypes are embedded in
+        StableHLO, so the hash covers them; the
+        ``CudnnConvolutionHelper.java:64-140`` descriptor/algo-cache
+        role).  Returns (executable id, was_cache_hit)."""
+        err = ctypes.create_string_buffer(2048)
+        hit = ctypes.c_int()
+        copts = (self.default_compile_options()
+                 if compile_options is None else compile_options)
+        exec_id = self._lib.dl4j_pjrt_compile_cached(
+            self._h, mlir.encode(), copts, len(copts), ctypes.byref(hit),
+            err, len(err))
+        if exec_id < 0:
+            raise RuntimeError(f"compile failed: {err.value.decode()}")
+        return exec_id, bool(hit.value)
+
+    def cache_clear(self) -> int:
+        """Drop all cached executables (long-lived clients serving many
+        program shapes own their memory policy; in-flight executions are
+        safe — pinned entries destroy on completion).  Compiled ids
+        become invalid."""
+        return int(self._lib.dl4j_pjrt_cache_clear(self._h))
+
+    def cache_stats(self) -> dict:
+        hits = ctypes.c_int64()
+        misses = ctypes.c_int64()
+        entries = ctypes.c_int64()
+        self._lib.dl4j_pjrt_cache_stats(self._h, ctypes.byref(hits),
+                                        ctypes.byref(misses),
+                                        ctypes.byref(entries))
+        return {"hits": hits.value, "misses": misses.value,
+                "entries": entries.value}
+
+    def output_info(self, exec_id: int) -> List[Tuple[str, Tuple[int, ...]]]:
+        """[(dtype_name, shape), ...] for a compiled executable's
+        outputs."""
+        self._dtype_codes()
+        max_out, max_dims = 64, 512
+        dtypes = (ctypes.c_int * max_out)()
+        ranks = (ctypes.c_int * max_out)()
+        dims = (ctypes.c_int64 * max_dims)()
+        n = self._lib.dl4j_pjrt_exec_output_info(
+            self._h, exec_id, dtypes, ranks, dims, max_out, max_dims)
+        if n < 0:
+            raise RuntimeError("output_info failed (bad exec id?)")
+        out, cursor = [], 0
+        for i in range(n):
+            shape = tuple(int(dims[cursor + j]) for j in range(ranks[i]))
+            cursor += ranks[i]
+            out.append((self._code_to_name[dtypes[i]], shape))
+        return out
+
+    def execute(self, exec_id: int,
+                inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Run a cached executable with typed arbitrary-rank inputs;
+        returns the typed, shaped outputs."""
+        codes = self._dtype_codes()
+        ins = [np.ascontiguousarray(a) for a in inputs]
+        n_in = len(ins)
+        in_ptrs = (ctypes.c_void_p * n_in)(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in ins])
+        in_dtypes = (ctypes.c_int * n_in)(
+            *[codes[_np_dtype_name(a.dtype)] for a in ins])
+        in_ranks = (ctypes.c_int * n_in)(*[a.ndim for a in ins])
+        all_dims = [d for a in ins for d in a.shape]
+        in_dims = (ctypes.c_int64 * max(1, len(all_dims)))(*all_dims)
+        info = self.output_info(exec_id)
+        outs = [np.empty(shape, _name_to_np(name)) for name, shape in info]
+        out_ptrs = (ctypes.c_void_p * len(outs))(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in outs])
+        out_sizes = (ctypes.c_int64 * len(outs))(*[a.nbytes for a in outs])
+        err = ctypes.create_string_buffer(2048)
+        rc = self._lib.dl4j_pjrt_execute(
+            self._h, exec_id, in_ptrs, in_dtypes, in_ranks, in_dims, n_in,
+            out_ptrs, out_sizes, len(outs), err, len(err))
+        if rc != 0:
+            raise RuntimeError(
+                f"execute failed (rc={rc}): {err.value.decode()}")
+        return outs
+
+    def run(self, mlir: str, inputs: Sequence[np.ndarray],
+            compile_options: Optional[bytes] = None) -> List[np.ndarray]:
+        """compile_cached + execute in one call (repeat calls with the
+        same program hit the executable cache)."""
+        exec_id, _ = self.compile_cached(mlir, compile_options)
+        return self.execute(exec_id, inputs)
+
+    def buffer_from_host(self, array: np.ndarray) -> int:
+        """Upload a host array to a persistent device buffer; returns its
+        id for use in :meth:`execute_mixed`.  Model params upload once and
+        stay device-resident (ND4J INDArray role)."""
+        codes = self._dtype_codes()
+        a = np.ascontiguousarray(array)
+        # (the C call awaits transfer completion before returning, so `a`
+        # only needs to stay alive for the duration of this call)
+        dims = (ctypes.c_int64 * max(1, a.ndim))(*a.shape)
+        err = ctypes.create_string_buffer(2048)
+        buf_id = self._lib.dl4j_pjrt_buffer_from_host(
+            self._h, a.ctypes.data_as(ctypes.c_void_p),
+            codes[_np_dtype_name(a.dtype)], dims, a.ndim, err, len(err))
+        if buf_id < 0:
+            raise RuntimeError(
+                f"buffer_from_host failed: {err.value.decode()}")
+        return buf_id
+
+    def buffer_free(self, buf_id: int) -> None:
+        self._lib.dl4j_pjrt_buffer_free(self._h, buf_id)
+
+    def execute_mixed(self, exec_id: int, arg_spec: Sequence,
+                      ) -> List[np.ndarray]:
+        """Run a cached executable where each argument is either a
+        device-buffer id (int) or a host numpy array — the hot inference
+        path transfers only the activation arguments."""
+        codes = self._dtype_codes()
+        n = len(arg_spec)
+        buf_ids = (ctypes.c_int64 * n)(
+            *[int(a) if isinstance(a, (int, np.integer)) else -1
+              for a in arg_spec])
+        host = [np.ascontiguousarray(a) for a in arg_spec
+                if not isinstance(a, (int, np.integer))]
+        n_host = len(host)
+        host_ptrs = (ctypes.c_void_p * max(1, n_host))(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in host])
+        host_dtypes = (ctypes.c_int * max(1, n_host))(
+            *[codes[_np_dtype_name(a.dtype)] for a in host])
+        host_ranks = (ctypes.c_int * max(1, n_host))(
+            *[a.ndim for a in host])
+        all_dims = [d for a in host for d in a.shape]
+        host_dims = (ctypes.c_int64 * max(1, len(all_dims)))(*all_dims)
+        info = self.output_info(exec_id)
+        outs = [np.empty(shape, _name_to_np(name)) for name, shape in info]
+        out_ptrs = (ctypes.c_void_p * len(outs))(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in outs])
+        out_sizes = (ctypes.c_int64 * len(outs))(*[a.nbytes for a in outs])
+        err = ctypes.create_string_buffer(2048)
+        rc = self._lib.dl4j_pjrt_execute_mixed(
+            self._h, exec_id, buf_ids, host_ptrs, host_dtypes, host_ranks,
+            host_dims, n, out_ptrs, out_sizes, len(outs), err, len(err))
+        if rc != 0:
+            raise RuntimeError(
+                f"execute_mixed failed (rc={rc}): {err.value.decode()}")
+        return outs
 
     def run_mlir(self, mlir: str, inputs: Sequence[np.ndarray],
                  out_size: int,
