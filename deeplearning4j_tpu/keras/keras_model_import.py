@@ -34,7 +34,8 @@ from ..nn.conf import inputs as _inputs
 from ..nn.conf.computation_graph import MergeVertex, ElementWiseVertex
 from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
 from ..nn.computation_graph import ComputationGraph
-from ..nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+from ..nn.layers.convolution import (ConvolutionLayer, SubsamplingLayer,
+                                     ZeroPaddingLayer)
 from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
                               EmbeddingLayer, OutputLayer)
 from ..nn.layers.normalization import BatchNormalization
@@ -102,8 +103,11 @@ def _convert_layer(cls: str, cfg: dict, weights: Dict[str, np.ndarray],
         ordering = cfg.get("dim_ordering", dim_ordering) or "tf"
         W = weights["W"]
         if ordering == "th":
-            # (nb_filter, stack, kh, kw) -> HWIO
-            W = W.transpose(2, 3, 1, 0)
+            # (nb_filter, stack, kh, kw) -> HWIO, and Theano rotates
+            # filters 180° before applying (true convolution, vs the
+            # cross-correlation XLA/TF compute) — undo it (reference
+            # ``KerasConvolution.java:127-139`` reverses each filter)
+            W = W[:, :, ::-1, ::-1].transpose(2, 3, 1, 0)
         border = cfg.get("border_mode", "valid")
         mode = "same" if border == "same" else "truncate"
         layer = ConvolutionLayer(
@@ -113,6 +117,10 @@ def _convert_layer(cls: str, cfg: dict, weights: Dict[str, np.ndarray],
             convolution_mode=mode,
             activation=_map_activation(act))
         return _ImportedLayer(layer, {"W": W, "b": weights["b"]})
+    if cls == "ZeroPadding2D":
+        ph, pw = cfg.get("padding", (1, 1))
+        return _ImportedLayer(
+            ZeroPaddingLayer(padding=(ph, ph, pw, pw)), None)
     if cls in ("MaxPooling2D", "AveragePooling2D"):
         border = cfg.get("border_mode", "valid")
         layer = SubsamplingLayer(
@@ -153,6 +161,58 @@ def _convert_layer(cls: str, cfg: dict, weights: Dict[str, np.ndarray],
                             weights["b_i"]])
         return _ImportedLayer(layer, {"W": W, "RW": U, "b": b})
     raise ValueError(f"Unsupported Keras layer class '{cls}'")
+
+
+def _conv_out(size: int, k: int, s: int, border: str) -> int:
+    if border == "same":
+        return -(-size // s)          # ceil
+    return (size - k) // s + 1        # valid
+
+
+def _track_spatial(cls: str, cfg: dict, spatial):
+    """Propagate (h, w, c) through conv/pool configs so a th-ordering
+    Flatten->Dense can be layout-corrected (below)."""
+    if spatial is None:
+        return None
+    h, w, c = spatial
+    if cls == "Convolution2D":
+        s = cfg.get("subsample", (1, 1))
+        border = cfg.get("border_mode", "valid")
+        return (_conv_out(h, cfg["nb_row"], s[0], border),
+                _conv_out(w, cfg["nb_col"], s[1], border),
+                cfg["nb_filter"])
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        k = cfg.get("pool_size", (2, 2))
+        s = cfg.get("strides") or k
+        border = cfg.get("border_mode", "valid")
+        return (_conv_out(h, k[0], s[0], border),
+                _conv_out(w, k[1], s[1], border), c)
+    if cls == "ZeroPadding2D":
+        ph, pw = cfg.get("padding", (1, 1))
+        return (h + 2 * ph, w + 2 * pw, c)
+    if cls in ("Activation", "Dropout", "BatchNormalization", "Flatten"):
+        return spatial
+    return None  # Dense etc. leave the spatial domain
+
+
+def _input_spatial(cfg: dict, dim_ordering: Optional[str]):
+    """(h, w, c) from a 4D ``batch_input_shape``, else None."""
+    shape = cfg.get("batch_input_shape")
+    if shape is None or len(shape) != 4:
+        return None
+    dims = shape[1:]
+    return (tuple(dims[1:]) + (dims[0],) if dim_ordering == "th"
+            else tuple(dims))
+
+
+def _th_flatten_permutation(spatial) -> np.ndarray:
+    """Row permutation taking a Keras-Theano flattened (C, H, W) dense
+    kernel to this framework's NHWC (H, W, C) flatten order (reference
+    role: ``TensorFlowCnnToFeedForwardPreProcessor`` exists because
+    orderings genuinely differ — DL4J is NCHW so 'th' was free there;
+    we are NHWC so 'th' needs the permutation and 'tf' is free)."""
+    h, w, c = spatial
+    return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).ravel()
 
 
 def _keras_input_type(cfg: dict, dim_ordering: str):
@@ -214,6 +274,8 @@ def import_keras_sequential_model_and_weights(path: str,
         for lc in layer_confs:
             cfg = lc["config"]
             dim_ordering = cfg.get("dim_ordering", dim_ordering)
+        spatial = None          # (h, w, c) while inside the conv domain
+        flatten_perm = None     # pending th-order Flatten->Dense fixup
         for i, lc in enumerate(layer_confs):
             cls, cfg = lc["class_name"], lc["config"]
             name = cfg.get("name") or cfg.get("layer_name") or f"layer_{i}"
@@ -221,10 +283,33 @@ def import_keras_sequential_model_and_weights(path: str,
                 it = _keras_input_type(cfg, dim_ordering or "tf")
                 if it is not None:
                     input_type = it
-            conv = _convert_layer(cls, cfg, _layer_weights(wgroup, name),
-                                  dim_ordering)
+                    spatial = _input_spatial(cfg, dim_ordering)
+            weights = _layer_weights(wgroup, name)
+            if (cls == "Flatten" and dim_ordering == "th"
+                    and spatial is not None):
+                # Keras-th flattened (C,H,W); we flatten NHWC -> permute
+                # the next Dense kernel's input rows
+                flatten_perm = _th_flatten_permutation(spatial)
+            if cls == "Dense" and flatten_perm is not None:
+                weights = dict(weights)
+                weights["W"] = np.asarray(weights["W"])[flatten_perm]
+                flatten_perm = None
+            spatial = _track_spatial(cls, cfg, spatial)
+            conv = _convert_layer(cls, cfg, weights, dim_ordering)
             if conv is not None:
                 imported.append(conv)
+
+        # Keras commonly ends Dense(linear) + Activation(softmax): fold
+        # the trailing Activation into the Dense before output-collapse
+        if (len(imported) >= 2
+                and isinstance(imported[-1].conf_layer, ActivationLayer)
+                and isinstance(imported[-2].conf_layer, DenseLayer)):
+            act_layer = imported.pop()
+            d = imported[-1].conf_layer
+            imported[-1] = _ImportedLayer(
+                DenseLayer(n_out=d.n_out,
+                           activation=act_layer.conf_layer.activation),
+                imported[-1].params)
 
         # last Dense becomes OutputLayer (reference KerasLoss handling)
         last = imported[-1]
@@ -284,51 +369,122 @@ def import_keras_model_and_weights(path: str,
         input_types = []
         imported: Dict[str, _ImportedLayer] = {}
         passthrough: Dict[str, str] = {}  # flatten-like no-op mapping
+        spatial_of: Dict[str, object] = {}   # name -> (h, w, c) or None
+        perm_of: Dict[str, np.ndarray] = {}  # name -> pending th-flat perm
+        records: List[tuple] = []  # ("layer"|"vertex", name, obj, in_names)
 
         def resolve(name: str) -> str:
             while name in passthrough:
                 name = passthrough[name]
             return name
 
+        # -- phase 1: parse every layer into records ------------------------
         for lc in layer_confs:
             cls, cfg = lc["class_name"], lc["config"]
             name = lc.get("name") or cfg.get("name")
             inbound = lc.get("inbound_nodes") or []
             # keras1 inbound_nodes: [[[name, node_idx, tensor_idx], ...]]
-            in_names = ([resolve(x[0]) for x in inbound[0]]
-                        if inbound else [])
+            raw_in = [x[0] for x in inbound[0]] if inbound else []
+            in_names = [resolve(x) for x in raw_in]
+            in_spatial = spatial_of.get(raw_in[0]) if raw_in else None
+            inherited_perm = perm_of.get(raw_in[0]) if raw_in else None
             if cls == "InputLayer":
                 input_types.append(
                     _keras_input_type(cfg, dim_ordering or "tf"))
+                spatial_of[name] = _input_spatial(cfg, dim_ordering)
                 continue
             if cls == "Flatten":
                 passthrough[name] = in_names[0]
+                if dim_ordering == "th" and in_spatial is not None:
+                    perm_of[name] = _th_flatten_permutation(in_spatial)
                 continue
             if cls == "Merge":
                 mode = cfg.get("mode", "concat")
                 if mode == "concat":
-                    g.add_vertex(name, MergeVertex(), *in_names)
+                    records.append(("vertex", name, MergeVertex(),
+                                    in_names))
                 elif mode == "sum":
-                    g.add_vertex(name, ElementWiseVertex(op="add"),
-                                 *in_names)
+                    records.append(("vertex", name,
+                                    ElementWiseVertex(op="add"), in_names))
                 else:
                     raise ValueError(f"Unsupported Merge mode '{mode}'")
                 continue
-            conv = _convert_layer(cls, cfg, _layer_weights(wgroup, name),
-                                  dim_ordering)
+            weights = _layer_weights(wgroup, name)
+            if inherited_perm is not None:
+                # a th Flatten upstream still awaits its Dense consumer
+                if cls == "Dense":
+                    weights = dict(weights)
+                    weights["W"] = np.asarray(
+                        weights["W"])[inherited_perm]
+                elif cls in ("Activation", "Dropout"):
+                    perm_of[name] = inherited_perm  # order-preserving
+                else:
+                    raise ValueError(
+                        f"th Flatten feeding a '{cls}' layer is not "
+                        "supported (the pending layout permutation "
+                        "cannot flow through it)")
+            spatial_of[name] = _track_spatial(cls, cfg, in_spatial)
+            conv = _convert_layer(cls, cfg, weights, dim_ordering)
             if conv is None:
                 passthrough[name] = in_names[0]
+                if inherited_perm is not None:
+                    perm_of[name] = inherited_perm
                 continue
-            if name in output_names and isinstance(conv.conf_layer,
-                                                   DenseLayer):
-                d = conv.conf_layer
-                conv = _ImportedLayer(
+            imported[name] = conv
+            records.append(("layer", name, conv, in_names))
+
+        # -- phase 2: output folds ------------------------------------------
+        by_name = {r[1]: i for i, r in enumerate(records)}
+
+        def record_of(name):
+            i = by_name.get(resolve(name))
+            return records[i] if i is not None else None
+
+        for out in output_names:
+            rec = record_of(out)
+            if rec is None or rec[0] != "layer":
+                continue
+            kind, name, il, in_names = rec
+            # Dense(linear) -> Activation at an output folds into the
+            # Dense before output-collapse (same as the sequential path)
+            if (isinstance(il.conf_layer, ActivationLayer)
+                    and len(in_names) == 1):
+                prev = record_of(in_names[0])
+                if (prev is not None and prev[0] == "layer"
+                        and isinstance(prev[2].conf_layer, DenseLayer)):
+                    d = prev[2].conf_layer
+                    records[by_name[prev[1]]] = (
+                        "layer", prev[1],
+                        _ImportedLayer(
+                            DenseLayer(n_out=d.n_out,
+                                       activation=il.conf_layer.activation),
+                            prev[2].params, prev[2].state),
+                        prev[3])
+                    imported.pop(name, None)
+                    imported[prev[1]] = records[by_name[prev[1]]][2]
+                    records[by_name[name]] = None
+                    passthrough[name] = prev[1]
+                    rec = records[by_name[prev[1]]]
+                    kind, name, il, in_names = rec
+            if isinstance(il.conf_layer, DenseLayer):
+                d = il.conf_layer
+                folded = _ImportedLayer(
                     OutputLayer(n_out=d.n_out,
                                 activation=d.activation or "softmax",
                                 loss="mcxent" if d.activation == "softmax"
-                                else "mse"), conv.params, conv.state)
-            imported[name] = conv
-            g.add_layer(name, conv.conf_layer, *in_names)
+                                else "mse"), il.params, il.state)
+                records[by_name[name]] = ("layer", name, folded, in_names)
+                imported[name] = folded
+
+        # -- phase 3: build the graph ---------------------------------------
+        for rec in records:
+            if rec is None:
+                continue
+            kind, name, obj, in_names = rec
+            if kind == "vertex":
+                g.add_vertex(name, obj, *in_names)
+            else:
+                g.add_layer(name, obj.conf_layer, *in_names)
 
         g.add_inputs(*input_names)
         g.set_outputs(*[resolve(n) for n in output_names])
