@@ -107,11 +107,27 @@ def japanese_tokenize(text: str) -> List[str]:
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Reference ``JapaneseTokenizerFactory`` (Kuromoji role) — see
-    module docstring for the dictionary caveat."""
+    """Reference ``JapaneseTokenizerFactory`` (Kuromoji role).
+
+    ``mode="lattice"`` (default) runs the dictionary lattice + Viterbi
+    tokenizer (``nlp/lattice.py`` — the actual Kuromoji algorithm over a
+    bundled dictionary); ``mode="heuristic"`` keeps the script-run
+    segmenter for dictionary-free use."""
+
+    def __init__(self, mode: str = "lattice", dictionary=None):
+        super().__init__()
+        if mode not in ("lattice", "heuristic"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self._lattice = None
+        if mode == "lattice":
+            from .lattice import LatticeTokenizer
+            self._lattice = LatticeTokenizer(entries=dictionary)
 
     def create(self, text: str) -> Tokenizer:
-        return Tokenizer(japanese_tokenize(text), self._preprocessor)
+        tokens = (self._lattice.tokenize(text) if self._lattice is not None
+                  else japanese_tokenize(text))
+        return Tokenizer(tokens, self._preprocessor)
 
 
 # ----------------------------------------------------------------- korean

@@ -71,3 +71,88 @@ def scaling_report(net_factory: Callable[[], object],
             "efficiency": round(tput / (w * base), 4),
         }
     return out
+
+
+def collective_overhead_report(net_factory: Callable[[], object],
+                               batch_size: int = 256,
+                               feature_shape=(784,), n_classes: int = 10,
+                               steps: int = 40, trials: int = 3,
+                               pipeline: int = 4) -> dict:
+    """Bound the shard_map/collective cost on ONE real chip (round-3
+    verdict: with no multi-chip hardware, the honest scaling substitute
+    is the measured overhead of the sharded program at workers=1 —
+    pmean over a 1-slot axis plus shard_map plumbing vs the plain jitted
+    step; the true N-chip cost adds only the ICI all-reduce itself).
+
+    Returns per-path step times and the overhead ratio.  Both paths run
+    ``steps`` dispatches per completion fetch (tunnel-latency amortized,
+    same as bench.py), best of ``trials``."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    f = rng.rand(batch_size, *feature_shape).astype(np.float32)
+    l = np.eye(n_classes, dtype=np.float32)[
+        rng.randint(0, n_classes, batch_size)]
+
+    # --- plain jitted step ------------------------------------------------
+    net = net_factory()
+    net.init()
+    is_graph = hasattr(net, "conf") and hasattr(net.conf, "network_inputs")
+    fj = jnp.asarray(f)
+    lj = jnp.asarray(l)
+    if is_graph:
+        fj, lj = (fj,), (lj,)   # ComputationGraph: tuple-of-inputs
+    state = [net.params, net.updater_state, net.net_state, 0]
+
+    def plain_dispatch():
+        (state[0], state[1], state[2], score) = net._train_step(
+            state[0], state[1], state[2], state[3], fj, lj, None, None,
+            net._rng_key)
+        state[3] += 1
+        return score
+
+    float(np.asarray(plain_dispatch()))
+
+    def plain_timed() -> float:
+        t0 = time.perf_counter()
+        for _ in range(pipeline * steps):
+            s = plain_dispatch()
+        float(np.asarray(s))
+        return time.perf_counter() - t0
+
+    plain = min(plain_timed() for _ in range(trials)) / (pipeline * steps)
+
+    # --- shard_map(workers=1) step ---------------------------------------
+    net2 = net_factory()
+    net2.init()
+    pw = ParallelWrapper(net2, workers=1, averaging_frequency=1,
+                         devices=jax.devices()[:1])
+    fs = jnp.asarray(f[None, None])      # (k=1, w=1, B, ...)
+    ls = jnp.asarray(l[None, None])
+    if is_graph:
+        fs, ls = (fs,), (ls,)
+    wstate = [net2.params,
+              jax.tree.map(lambda a: a[None], net2.updater_state),
+              net2.net_state]
+
+    def pw_dispatch():
+        (wstate[0], wstate[1], wstate[2], score) = pw._parallel_step(
+            wstate[0], wstate[1], wstate[2], 0, fs, ls, None, None,
+            net2._rng_key)
+        return score
+
+    float(np.asarray(pw_dispatch()))
+
+    def pw_timed() -> float:
+        t0 = time.perf_counter()
+        for _ in range(pipeline * steps):
+            s = pw_dispatch()
+        float(np.asarray(s))
+        return time.perf_counter() - t0
+
+    sharded = min(pw_timed() for _ in range(trials)) / (pipeline * steps)
+    return {"plain_step_ms": round(plain * 1e3, 4),
+            "shard_map_step_ms": round(sharded * 1e3, 4),
+            "overhead_ms": round((sharded - plain) * 1e3, 4),
+            "overhead_ratio": round(sharded / plain, 4),
+            "batch": batch_size, "device": str(jax.devices()[0])}

@@ -3,24 +3,30 @@
 Reference: ``deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/
 .../parallelism/ParameterServerParallelWrapper.java`` (workers train
 replicas and exchange parameters through ND4J's Aeron-based parameter
-server — UDP media driver, native C++/Java) and the
-``nd4j-parameter-server`` update/subscribe model.
+server — UDP media driver, native C++/Java; server node at ``:161``,
+per-worker clients at ``:215-216``) and the ``nd4j-parameter-server``
+update/subscribe model.
 
 TPU-native redesign: synchronous data parallelism rides XLA collectives
 (``parallel/parallel_wrapper.py``); the *asynchronous* path — staleness-
 tolerant Hogwild-style updates, the reason the reference runs a parameter
-server at all — is hosted here as an in-process server with the same
-push/pull surface the Aeron transport provides.  Workers run their jitted
-replica steps concurrently (JAX releases the GIL during device compute,
-so worker threads genuinely overlap), push parameter deltas, and pull the
-latest consolidated parameters; the server applies deltas as they arrive.
-Multi-host deployments would swap the thread transport for
-``jax.distributed`` DCN messaging with the same ParameterServer surface
-(the ``scaleout/dcn.py`` wiring).
+server at all — keeps the Aeron push/pull surface with two transports:
+
+- :class:`ParameterServer` — the in-process store (threads sharing the
+  lock; workers' jitted steps overlap because JAX releases the GIL during
+  device compute).
+- :class:`TcpParameterServer` / :class:`TcpParameterServerClient` — the
+  CROSS-PROCESS transport: a socket server owning the store, clients in
+  other OS processes (or hosts) pushing deltas and pulling snapshots over
+  a length-prefixed binary protocol.  This is the media-driver role; run
+  one standalone with ``python -m deeplearning4j_tpu.scaleout.param_server
+  --serve --dim N --port P``.
 """
 
 from __future__ import annotations
 
+import socket
+import struct
 import threading
 from typing import Callable, List, Optional
 
@@ -51,9 +57,171 @@ class ParameterServer:
 
     def push(self, delta: np.ndarray) -> None:
         d = np.asarray(delta, np.float64)
+        if d.shape != self._params.shape:
+            raise ValueError(
+                f"delta shape {d.shape} != param shape "
+                f"{self._params.shape} (a size-1 delta would silently "
+                "broadcast-corrupt every parameter)")
         with self._lock:
             self._params += self.update_scale * d
             self.pushes += 1
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpParameterServer:
+    """Socket front-end over a :class:`ParameterServer` — the
+    cross-process transport (reference: the embedded Aeron MediaDriver +
+    ``ParameterServerNode``, ``ParameterServerParallelWrapper.java:161``).
+
+    Wire protocol (all integers big-endian u64):
+    ``P``               -> reply: len ‖ f64 param bytes     (pull)
+    ``U`` len ‖ bytes   -> reply: ``K`` ok / ``E`` rejected (push delta)
+    ``S``               -> reply: u64 push count            (stats)
+    ``Q`` / EOF         -> close connection
+    """
+
+    def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server = server
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            with self._lock:
+                # prune finished handlers so a long-lived server doesn't
+                # grow a dead-Thread list without bound
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+                self._conns = [c for c in self._conns if c.fileno() >= 0]
+                self._conns.append(conn)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    op = conn.recv(1)
+                    if not op or op == b"Q":
+                        return
+                    if op == b"P":
+                        data = self.server.pull().tobytes()
+                        conn.sendall(struct.pack(">Q", len(data)) + data)
+                    elif op == b"U":
+                        (n,) = struct.unpack(">Q", _recv_exact(conn, 8))
+                        delta = np.frombuffer(_recv_exact(conn, n),
+                                              np.float64)
+                        try:
+                            self.server.push(delta)
+                        except ValueError:
+                            conn.sendall(b"E")   # dimension mismatch
+                            continue
+                        conn.sendall(b"K")
+                    elif op == b"S":
+                        conn.sendall(struct.pack(">Q", self.server.pushes))
+                    else:
+                        return
+        except (ConnectionError, OSError):
+            return
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            # wake clients blocked in recv with EOF instead of leaving
+            # them to their own socket timeout
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class TcpParameterServerClient:
+    """Push/pull client over TCP — duck-typed to :class:`ParameterServer`
+    so :class:`ParameterServerParallelWrapper` workers use either
+    transport interchangeably (reference ``ParameterServerClient``,
+    ``ParameterServerParallelWrapper.java:215-216``).  One client per
+    worker thread; a socket is not shared."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._conn = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            self._conn.sendall(b"P")
+            (n,) = struct.unpack(">Q", _recv_exact(self._conn, 8))
+            return np.frombuffer(_recv_exact(self._conn, n),
+                                 np.float64).copy()
+
+    def push(self, delta: np.ndarray) -> None:
+        data = np.asarray(delta, np.float64).tobytes()
+        with self._lock:
+            self._conn.sendall(b"U" + struct.pack(">Q", len(data)) + data)
+            ack = _recv_exact(self._conn, 1)
+            if ack == b"E":
+                raise ValueError(
+                    "server rejected push: delta dimension does not "
+                    "match the store")
+            if ack != b"K":
+                raise ConnectionError("push not acknowledged")
+
+    @property
+    def pushes(self) -> int:
+        with self._lock:
+            self._conn.sendall(b"S")
+            (n,) = struct.unpack(">Q", _recv_exact(self._conn, 8))
+            return n
+
+    def close(self) -> None:
+        try:
+            self._conn.sendall(b"Q")
+        except OSError:
+            pass
+        self._conn.close()
+
+    def __enter__(self) -> "TcpParameterServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class ParameterServerParallelWrapper:
@@ -68,31 +236,72 @@ class ParameterServerParallelWrapper:
 
     def __init__(self, model, num_workers: int = 2,
                  batches_per_push: int = 1,
-                 update_scale: Optional[float] = None):
+                 update_scale: Optional[float] = None,
+                 server_address: Optional[tuple] = None):
+        """``server_address=(host, port)`` switches workers to the TCP
+        transport against an external server process (reference: Aeron
+        clients against a remote ParameterServerNode); default is the
+        in-process store.  In TCP mode the SERVER owns ``update_scale``
+        (``--update-scale`` on its command line) — passing it here would
+        be silently ignored, so it raises instead."""
         self.model = model.init() if hasattr(model, "init") else model
         self.num_workers = int(num_workers)
         self.batches_per_push = int(batches_per_push)
-        scale = (1.0 / self.num_workers if update_scale is None
-                 else update_scale)
-        self.server = ParameterServer(self.model.get_flat_params(), scale)
+        self._address = server_address
+        if server_address is None:
+            scale = (1.0 / self.num_workers if update_scale is None
+                     else update_scale)
+            self.server = ParameterServer(self.model.get_flat_params(),
+                                          scale)
+        else:
+            if update_scale is not None:
+                raise ValueError(
+                    "update_scale is server-side in TCP mode: launch the "
+                    "server with --update-scale instead")
+            self.server = TcpParameterServerClient(*server_address)
         self._replicas = [self.model.clone()
                           for _ in range(self.num_workers)]
         self._errors: List[BaseException] = []
 
+    def close(self) -> None:
+        """Release the transport (the TCP client socket; no-op for the
+        in-process store)."""
+        if self._address is not None and self.server is not None:
+            self.server.close()
+            self.server = None
+
+    def __enter__(self) -> "ParameterServerParallelWrapper":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _make_worker_client(self):
+        """Each worker needs its own transport endpoint (sockets are not
+        shared across threads; the in-process store is)."""
+        if self._address is None:
+            return self.server
+        return TcpParameterServerClient(*self._address)
+
     def _worker(self, replica, batches: List[DataSet]) -> None:
+        server = None
         try:
+            server = self._make_worker_client()
             i = 0
             while i < len(batches):
-                start = self.server.pull()
+                start = server.pull()
                 replica.set_flat_params(start)
                 for _ in range(self.batches_per_push):
                     if i >= len(batches):
                         break
                     replica._fit_batch(batches[i])
                     i += 1
-                self.server.push(replica.get_flat_params() - start)
+                server.push(replica.get_flat_params() - start)
         except BaseException as e:  # surfaced after join
             self._errors.append(e)
+        finally:
+            if server is not None and server is not self.server:
+                server.close()
 
     def fit(self, iterator, epochs: int = 1):
         """Split each epoch's batches round-robin across workers and train
@@ -119,3 +328,44 @@ class ParameterServerParallelWrapper:
                 raise self._errors[0]
         self.model.set_flat_params(self.server.pull())
         return self.model
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone parameter-server process (the MediaDriver+node role):
+    ``python -m deeplearning4j_tpu.scaleout.param_server --serve --dim N
+    [--port P] [--init params.npy] [--update-scale S]``.  Prints one JSON
+    line ``{"host":..., "port":...}`` on stdout when ready."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true", required=True)
+    ap.add_argument("--dim", type=int, default=None)
+    ap.add_argument("--init", type=str, default=None,
+                    help=".npy with initial flat params (overrides --dim)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", type=str, default="127.0.0.1")
+    ap.add_argument("--update-scale", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    if args.init:
+        init = np.load(args.init)
+    elif args.dim is not None:
+        init = np.zeros(args.dim, np.float64)
+    else:
+        ap.error("--dim or --init required")
+    store = ParameterServer(init, update_scale=args.update_scale)
+    srv = TcpParameterServer(store, host=args.host, port=args.port)
+    print(json.dumps({"host": srv.host, "port": srv.port}), flush=True)
+    try:
+        threading.Event().wait()  # serve until killed
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

