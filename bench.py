@@ -373,6 +373,8 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
         float(np.asarray(losses)[-1])   # fetch = completion barrier
         return s0, s1
 
+    # roofline from a 1-step twin (see _run_scan_bench)
+    cost = _compiled_cost(multi.lower(syn0, syn1, 1).compile())
     syn0, syn1 = run_once(syn0, syn1)
 
     def timed() -> float:
@@ -383,9 +385,11 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
 
     elapsed = _best_of(timed, trials)
     pairs = pipeline * steps * batch / elapsed
-    return {"metric": "word2vec_sgns_pairs_per_sec_per_chip",
-            "value": round(pairs, 1), "unit": "pairs/sec/chip",
-            "vs_baseline": None, "batch": batch}
+    result = {"metric": "word2vec_sgns_pairs_per_sec_per_chip",
+              "value": round(pairs, 1), "unit": "pairs/sec/chip",
+              "vs_baseline": None, "batch": batch}
+    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+    return result
 
 
 def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,

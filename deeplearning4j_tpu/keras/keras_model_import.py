@@ -103,11 +103,7 @@ def _convert_layer(cls: str, cfg: dict, weights: Dict[str, np.ndarray],
         ordering = cfg.get("dim_ordering", dim_ordering) or "tf"
         W = weights["W"]
         if ordering == "th":
-            # (nb_filter, stack, kh, kw) -> HWIO, and Theano rotates
-            # filters 180° before applying (true convolution, vs the
-            # cross-correlation XLA/TF compute) — undo it (reference
-            # ``KerasConvolution.java:127-139`` reverses each filter)
-            W = W[:, :, ::-1, ::-1].transpose(2, 3, 1, 0)
+            W = th_kernel_to_hwio(W)
         border = cfg.get("border_mode", "valid")
         mode = "same" if border == "same" else "truncate"
         layer = ConvolutionLayer(
@@ -205,6 +201,16 @@ def _input_spatial(cfg: dict, dim_ordering: Optional[str]):
             else tuple(dims))
 
 
+def th_kernel_to_hwio(W: np.ndarray) -> np.ndarray:
+    """Keras-Theano conv kernel (nb_filter, stack, kh, kw), stored with
+    Theano's 180°-rotated filters (true convolution, vs the
+    cross-correlation XLA computes — reference
+    ``KerasConvolution.java:127-139`` reverses each filter) -> HWIO.
+    Shared by the model importer and the trained-models loader so the two
+    can never disagree on Theano semantics."""
+    return W[:, :, ::-1, ::-1].transpose(2, 3, 1, 0)
+
+
 def _th_flatten_permutation(spatial) -> np.ndarray:
     """Row permutation taking a Keras-Theano flattened (C, H, W) dense
     kernel to this framework's NHWC (H, W, C) flatten order (reference
@@ -213,6 +219,12 @@ def _th_flatten_permutation(spatial) -> np.ndarray:
     we are NHWC so 'th' needs the permutation and 'tf' is free)."""
     h, w, c = spatial
     return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).ravel()
+
+
+def th_dense_rows_to_nhwc(W: np.ndarray, spatial) -> np.ndarray:
+    """Permute a post-Flatten dense kernel's input rows from Keras-th
+    (C, H, W) flatten order to NHWC flatten order."""
+    return np.asarray(W)[_th_flatten_permutation(spatial)]
 
 
 def _keras_input_type(cfg: dict, dim_ordering: str):

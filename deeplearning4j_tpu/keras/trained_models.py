@@ -157,20 +157,21 @@ def load_vgg16(weights_path: Optional[str] = None,
             if W.ndim == 4:
                 last_conv_channels = want[-1]
                 if W.shape[0] not in (1, 3) and W.shape[-1] != want[-1]:
-                    # th ordering (nb_filter, stack, kh, kw) -> HWIO
-                    W = W.transpose(2, 3, 1, 0)
+                    # th ordering; shared transform with the importer
+                    from .keras_model_import import th_kernel_to_hwio
+                    W = th_kernel_to_hwio(W)
                     th_detected = True
             elif (W.ndim == 2 and not seen_dense_after_conv
                   and last_conv_channels is not None):
                 seen_dense_after_conv = True
                 if th_detected:
                     # th flatten order is (C, H, W); this network flattens
-                    # NHWC — permute the first dense layer's input rows.
+                    # NHWC — permute the first dense layer's input rows
+                    # (shared transform with the importer).
+                    from .keras_model_import import th_dense_rows_to_nhwc
                     c = last_conv_channels
                     s = int(round((W.shape[0] / c) ** 0.5))
-                    W = (W.reshape(c, s, s, W.shape[1])
-                          .transpose(1, 2, 0, 3)
-                          .reshape(W.shape[0], W.shape[1]))
+                    W = th_dense_rows_to_nhwc(W, (s, s, c))
             net.params[i]["W"] = jnp.asarray(
                 W.reshape(want), net.params[i]["W"].dtype)
             net.params[i]["b"] = jnp.asarray(

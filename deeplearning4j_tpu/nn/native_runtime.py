@@ -109,9 +109,9 @@ class NativeModelRunner:
         exec_id = self._exec_for(avals)
         outs = self._client.execute_mixed(exec_id,
                                           [*self._buf_ids, *feats])
-        if self._is_graph:
-            return outs
-        return outs[0]
+        # same return convention as the containers: single array for one
+        # output, list for multi-output graphs
+        return outs[0] if len(outs) == 1 else outs
 
     def cache_stats(self) -> dict:
         return self._client.cache_stats()

@@ -417,3 +417,37 @@ class TestNativeDataPathIntegration:
                 s0 = net.score()
         assert net.score() < s0
         it.close()
+
+    def test_native_runner_computation_graph(self):
+        """The graph container through the native path: multi-output DAG
+        served by the C++ client."""
+        from deeplearning4j_tpu import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.computation_graph import MergeVertex
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.native_runtime import NativeModelRunner
+
+        g = (NeuralNetConfiguration.builder().seed(5)
+             .updater("sgd").learning_rate(0.1)
+             .activation("tanh").weight_init("xavier").graph_builder())
+        g.add_inputs("a", "b")
+        g.add_layer("da", DenseLayer(n_in=6, n_out=8), "a")
+        g.add_layer("db", DenseLayer(n_in=4, n_out=8), "b")
+        g.add_vertex("merge", MergeVertex(), "da", "db")
+        g.add_layer("out", OutputLayer(n_in=16, n_out=3,
+                                       activation="softmax",
+                                       loss="mcxent"), "merge")
+        g.set_outputs("out")
+        cg = ComputationGraph(g.build()).init()
+        try:
+            runner = NativeModelRunner(cg)
+        except RuntimeError as e:
+            pytest.skip(f"no usable PJRT plugin: {e}")
+        with runner:
+            rng = np.random.RandomState(2)
+            a = rng.randn(5, 6).astype(np.float32)
+            b = rng.randn(5, 4).astype(np.float32)
+            native = runner.output(a, b)
+            expect = cg.output(a, b)   # single array for 1-output graphs
+            np.testing.assert_allclose(native, np.asarray(expect),
+                                       rtol=2e-2, atol=2e-3)

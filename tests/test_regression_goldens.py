@@ -12,6 +12,10 @@ compatibility break.
 
 Regenerate (only when INTENTIONALLY breaking format):
 ``python tests/test_regression_goldens.py --regenerate``
+
+``tests/fixtures/regression/MANIFEST.md`` documents, per fixture, which
+serde schema / param-layout / forward-semantics decisions it pins and
+which generation-time behaviors (RNG stream, init math) it froze.
 """
 
 import json
