@@ -337,13 +337,29 @@ class SequenceVectors:
         if self._cb_count >= self.batch_size:
             self._drain_cbow(force=False)
 
+    def _effective_batch(self) -> int:
+        """Pairs per XLA step, clamped so duplicate-row scatter-adds stay
+        bounded: word2vec.c applies updates SEQUENTIALLY, so a word hit k
+        times sees k small self-correcting steps; one batched scatter-add
+        applies k gradients computed at the same stale point — an
+        effective k×lr that diverges once batch ≫ vocabulary (a 13-word
+        toy corpus at batch 2048 reached norm 1e18).  Clamping the chunk
+        to ~2×vocab keeps expected duplicates per row at ~2 (measured
+        stable AND quality-preserving on small corpora) while leaving
+        realistic vocabularies (vocab ≥ batch/2) at full batch size."""
+        v = self.vocab.num_words() if hasattr(self, "vocab") and \
+            self.vocab is not None else 0
+        if v <= 0:
+            return self.batch_size
+        return int(min(self.batch_size, max(64, 2 * v)))
+
     def _drain_skipgram(self, force: bool) -> None:
         if not self._sg_count:
             return
         ins = np.concatenate([q[0] for q in self._sg_queue])
         tgts = np.concatenate([q[1] for q in self._sg_queue])
         alphas = np.concatenate([q[2] for q in self._sg_queue])
-        B = self.batch_size
+        B = self._effective_batch()
         s = 0
         while ins.size - s >= B or (force and s < ins.size):
             sl = slice(s, s + B)
@@ -370,7 +386,7 @@ class SequenceVectors:
         cmask = np.concatenate([_w(q[1], 0.0) for q in self._cb_queue])
         ctrs = np.concatenate([q[2] for q in self._cb_queue])
         alphas = np.concatenate([q[3] for q in self._cb_queue])
-        B = self.batch_size
+        B = self._effective_batch()
         s = 0
         while ctrs.size - s >= B or (force and s < ctrs.size):
             sl = slice(s, s + B)
@@ -424,7 +440,7 @@ class SequenceVectors:
     def _skipgram_batch(self, inputs: np.ndarray, targets: np.ndarray,
                         alpha: float) -> None:
         lt = self.lookup_table
-        B = self.batch_size
+        B = self._effective_batch()
         inputs_p, pair_mask = self._pad(inputs.astype(np.int32), B)
         targets_p, _ = self._pad(targets.astype(np.int32), B)
         lr = jnp.float32(alpha)
@@ -443,7 +459,7 @@ class SequenceVectors:
     def _cbow_batch(self, ctx: np.ndarray, cmask: np.ndarray,
                     centers: np.ndarray, alpha: float) -> None:
         lt = self.lookup_table
-        B = self.batch_size
+        B = self._effective_batch()
         ctx_p, pair_mask = self._pad(ctx.astype(np.int32), B)
         cmask_p, _ = self._pad(cmask, B)
         centers_p, _ = self._pad(centers.astype(np.int32), B)

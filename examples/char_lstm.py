@@ -1,0 +1,62 @@
+"""Character-level language modeling with GravesLSTM + truncated BPTT,
+then sampling one character at a time with `rnn_time_step` (the
+`dl4j-examples` GravesLSTMCharModellingExample)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+import numpy as np
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+
+TEXT = ("the quick brown fox jumps over the lazy dog. " * 40
+        + "pack my box with five dozen liquor jugs. " * 40)
+
+
+def main(epochs: int = 30, hidden: int = 64, seq: int = 32):
+    chars = sorted(set(TEXT))
+    idx = {c: i for i, c in enumerate(chars)}
+    v = len(chars)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).updater("rmsprop").learning_rate(0.05)
+            .weight_init("xavier")
+            .list()
+            .backprop_type("tbptt")
+            .t_bptt_forward_length(seq).t_bptt_backward_length(seq)
+            .layer(GravesLSTM(n_in=v, n_out=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=hidden, n_out=v,
+                                  activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    ids = np.array([idx[c] for c in TEXT])
+    n = (len(ids) - 1) // seq
+    x = np.eye(v, dtype=np.float32)[ids[:n * seq].reshape(n, seq)]
+    y = np.eye(v, dtype=np.float32)[ids[1:n * seq + 1].reshape(n, seq)]
+    ds = DataSet(x, y)                       # (batch, time, features)
+
+    for _ in range(epochs):
+        net.fit(ds)
+    print("final score:", net.score())
+
+    # sample: feed one character at a time, carrying the rnn state
+    net.rnn_clear_previous_state()
+    rng = np.random.RandomState(0)
+    ch = idx["t"]
+    out = ["t"]
+    for _ in range(60):
+        probs = np.asarray(net.rnn_time_step(
+            np.eye(v, dtype=np.float32)[[ch]]))[0]
+        ch = int(rng.choice(v, p=probs / probs.sum()))
+        out.append(chars[ch])
+    print("sample:", "".join(out))
+    return net.score()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Keras-1 h5 model import: writes a Keras-1-format file the way Keras
+1.x did (model_config attr + per-layer weight groups), imports it, and
+predicts (the reference's KerasModelImport entry points)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+import json
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.keras.keras_model_import import (
+    import_keras_sequential_model_and_weights)
+
+
+def main():
+    import h5py
+    rng = np.random.RandomState(0)
+    W1, b1 = rng.randn(12, 8).astype(np.float32), np.zeros(8, np.float32)
+    W2, b2 = rng.randn(8, 3).astype(np.float32), np.zeros(3, np.float32)
+    conf = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": 8,
+                    "activation": "relu",
+                    "batch_input_shape": [None, 12]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "output_dim": 3,
+                    "activation": "softmax"}},
+    ]}
+    with tempfile.NamedTemporaryFile(suffix=".h5") as tmp:
+        with h5py.File(tmp.name, "w") as f:
+            f.attrs["model_config"] = json.dumps(conf).encode()
+            g = f.create_group("model_weights")
+            for name, W, b in (("dense_1", W1, b1), ("dense_2", W2, b2)):
+                lg = g.create_group(name)
+                lg.create_dataset(f"{name}_W", data=W)
+                lg.create_dataset(f"{name}_b", data=b)
+                lg.attrs["weight_names"] = [f"{name}_W".encode(),
+                                            f"{name}_b".encode()]
+        net = import_keras_sequential_model_and_weights(tmp.name)
+
+    x = rng.randn(4, 12).astype(np.float32)
+    probs = np.asarray(net.output(x))
+    print("predictions:", probs.argmax(1), " row sums:", probs.sum(1))
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-5)
+    return probs
+
+
+if __name__ == "__main__":
+    main()

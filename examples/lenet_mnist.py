@@ -1,0 +1,48 @@
+"""LeNet-5 on MNIST: native prefetch ring, on-chip multi-step scan,
+save/restore round trip (the `dl4j-examples` LenetMnistExample)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu import restore_multi_layer_network, write_model
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main(num_examples: int = 6400, epochs: int = 2) -> float:
+    import jax
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    net = MultiLayerNetwork(
+        lenet(compute_dtype="bfloat16" if on_tpu else None)).init()
+
+    # AsyncDataSetIterator rides the C++ prefetch ring when the native
+    # lib builds (shuffle + batch gather off the GIL)
+    it = AsyncDataSetIterator(MnistDataSetIterator(128, num_examples))
+    print("native prefetch:", it.native)
+    net.fit(it, epochs=epochs)
+    it.close()
+
+    ev = net.evaluate(MnistDataSetIterator(500, 2000, train=False))
+    print("accuracy:", ev.accuracy())
+
+    with tempfile.NamedTemporaryFile(suffix=".zip") as tmp:
+        write_model(net, tmp.name)
+        again = restore_multi_layer_network(tmp.name)
+    x = np.zeros((1, 784), np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(again.output(x)), atol=1e-6)
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.95, acc

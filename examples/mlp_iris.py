@@ -1,0 +1,40 @@
+"""Minimal classifier: builder config -> fit -> evaluate (the
+`dl4j-examples` iris MLP)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.iris import iris_dataset
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+
+def main(epochs: int = 60) -> float:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater("adam").learning_rate(0.02)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(inputs.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    ds = iris_dataset()
+    it = ListDataSetIterator(ds, batch_size=30, shuffle=True, seed=0)
+    net.fit(it, epochs=epochs)
+
+    ev = net.evaluate(ListDataSetIterator(ds, batch_size=50))
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.9, acc

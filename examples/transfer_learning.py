@@ -1,0 +1,62 @@
+"""Transfer learning: train a base model, freeze its feature extractor,
+swap the head for a new class count, fine-tune (the `dl4j-examples`
+transfer-learning examples on the reference's TransferLearning API)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+import numpy as np
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transfer import TransferLearning
+
+
+def main():
+    rng = np.random.RandomState(0)
+
+    # base task: 4 classes
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater("adam").learning_rate(0.02)
+            .activation("relu").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32))
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=4))
+            .set_input_type(inputs.feed_forward(10))
+            .build())
+    base = MultiLayerNetwork(conf).init()
+    xb = rng.randn(256, 10).astype(np.float32)
+    yb = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 256)]
+    for _ in range(10):
+        base.fit(DataSet(xb, yb))
+
+    # new task: 2 classes; keep + freeze the trunk, replace the head
+    net = (TransferLearning.builder(base)
+           .fine_tune_learning_rate(0.01)
+           .set_feature_extractor(1)          # freeze layers 0..1
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_in=16, n_out=2))
+           .build())
+
+    x = rng.randn(256, 10).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    frozen_before = np.asarray(net.params[0]["W"])
+    before = net.score(DataSet(x, y))
+    for _ in range(30):
+        net.fit(DataSet(x, y))
+    after = net.score(DataSet(x, y))
+    print(f"fine-tune score: {before:.4f} -> {after:.4f}")
+
+    # the frozen trunk did not move
+    np.testing.assert_array_equal(frozen_before,
+                                  np.asarray(net.params[0]["W"]))
+    assert after < before
+    return after
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Training dashboard: StatsListener -> StatsStorage -> HTTP server (the
+reference's `UIServer.getInstance().attach(statsStorage)` flow).
+
+Open http://127.0.0.1:<port> while it trains; Ctrl-C to stop."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+import numpy as np
+
+from deeplearning4j_tpu import DataSet, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats_listener import StatsListener
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+def main(iterations: int = 200, serve_forever: bool = False):
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0).start()
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("adam").learning_rate(0.01)
+            .activation("relu").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32))
+            .layer(OutputLayer(n_out=5))
+            .set_input_type(inputs.feed_forward(20))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, update_frequency=5))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 20).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.randint(0, 5, 512)]
+    print(f"dashboard: http://127.0.0.1:{server.port}")
+    for _ in range(iterations):
+        net.fit(DataSet(x, y))
+    print("final score:", net.score())
+    if serve_forever:
+        import threading
+        threading.Event().wait()
+    server.stop()
+    return net.score()
+
+
+if __name__ == "__main__":
+    main(serve_forever=False)

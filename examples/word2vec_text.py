@@ -1,0 +1,48 @@
+"""Word2Vec skip-gram with negative sampling on a toy corpus, then
+nearest-neighbour and analogy queries (the `dl4j-examples`
+Word2VecRawTextExample)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))   # run from anywhere
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+SENTENCES = [
+    "king man royal crown",
+    "queen woman royal crown",
+    "king rules the kingdom",
+    "queen rules the kingdom",
+    "the king is a man",
+    "the queen is a woman",
+    "a man walks the dog",
+    "a woman walks the dog",
+    "day sun bright light",
+    "night moon dark light",
+] * 60
+
+
+def main():
+    w2v = (Word2Vec.Builder()
+           .min_word_frequency(2)
+           .layer_size(24)
+           .window_size(3)
+           .seed(1)
+           .epochs(80)
+           .negative(5)
+           .batch_size(128)     # small corpus -> more sequential steps
+           .build())
+    w2v.fit(SENTENCES)
+
+    nearest = w2v.words_nearest("king", top_n=3)
+    print("nearest to 'king':", nearest)
+    print("king - man + woman ->",
+          w2v.words_nearest(["king", "woman"], negative=["man"], top_n=3))
+    assert "queen" in nearest, nearest
+    return w2v
+
+
+if __name__ == "__main__":
+    main()

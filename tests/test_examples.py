@@ -1,0 +1,44 @@
+"""The examples gallery must stay runnable (the dl4j-examples role —
+user-facing entry points are product surface, not documentation).  The
+fast CPU examples run here; the heavier ones (lenet_mnist, char_lstm,
+ui_dashboard — minutes of training — and native_inference, which needs a
+PJRT plugin) are exercised by their subsystem suites instead
+(test_nativeops, test_recurrent, test_ui)."""
+
+import os
+import runpy
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+
+def _run(name):
+    return runpy.run_path(os.path.join(EXAMPLES, name), run_name="example")
+
+
+def test_mlp_iris_example():
+    mod = _run("mlp_iris.py")
+    assert mod["main"](epochs=40) > 0.85
+
+
+def test_keras_import_example():
+    mod = _run("keras_import.py")
+    probs = mod["main"]()
+    assert probs.shape == (4, 3)
+
+
+def test_transfer_learning_example():
+    mod = _run("transfer_learning.py")
+    assert mod["main"]() > 0.0
+
+
+def test_parallel_training_example():
+    mod = _run("parallel_training.py")
+    assert mod["main"](workers=2, rounds=6) > 0.0
+
+
+def test_word2vec_example():
+    mod = _run("word2vec_text.py")
+    w2v = mod["main"]()   # asserts 'queen' ranks in nearest-to-'king'
+    assert w2v.has_word("king")

@@ -359,8 +359,11 @@ def test_paragraph_vectors_batches_across_documents(monkeypatch):
 
     monkeypatch.setattr(ParagraphVectors, "_skipgram_batch", counting)
     pv.fit(docs)
-    # 30 docs worth of pairs fit one 4096 batch: exactly 1 flush dispatch
-    assert calls["n"] == 1
+    # pairs accumulate ACROSS documents before flushing (the property
+    # under test): far fewer dispatches than documents.  Not exactly 1:
+    # the duplicate-bounding chunk clamp (SequenceVectors._effective_batch,
+    # ~2x vocab for tiny vocabularies) splits the accumulated batch.
+    assert calls["n"] < len(docs) / 3, calls["n"]
 
 
 def test_words_nearest_analogy_form():

@@ -135,3 +135,15 @@ class TestProfilerListener:
         assert rep["mean_ms"] > 0 and rep["p95_ms"] >= rep["p50_ms"]
         # a trace directory was produced for the captured window
         assert os.path.isdir(str(tmp_path / "trace"))
+
+
+def test_model_guesser_on_real_keras_fixture():
+    """ModelGuesser must recognize a file REAL Keras 1.1.2 produced (the
+    reference's ModelGuesser routes h5 -> KerasModelImport)."""
+    path = ("/root/reference/deeplearning4j-keras/src/test/resources/"
+            "theano_mnist/model.h5")
+    if not os.path.exists(path):
+        pytest.skip("reference fixture not mounted")
+    net = load_model_guess(path)
+    out = np.asarray(net.output(np.zeros((2, 28, 28, 1), np.float32)))
+    assert out.shape == (2, 10)
