@@ -393,7 +393,7 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
 
 
 def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
-                          d_head: int = 64, steps: int = 4,
+                          d_head: int = 64, steps: int = 8,
                           trials: int = 3) -> dict:
     """Pallas flash attention fwd+fused-bwd throughput at a sequence
     length the XLA attention path cannot compile (linear-memory
@@ -413,10 +413,13 @@ def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
     float(loss)                 # fetch = the reliable completion barrier
 
     def timed() -> float:
+        # async-pipelined dispatches, one device->host fetch as the
+        # barrier (block_until_ready is unreliable AND adds tunnel
+        # round-trips on this platform; loss and grads come from the
+        # same executable, so the loss fetch proves the step finished)
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, grads = lossg(q, k, v)
-        jax.block_until_ready(grads)
         float(loss)
         return time.perf_counter() - t0
 

@@ -153,6 +153,21 @@ def _clamp_block(block: int, t: int) -> int:
     return -(-min(block, max(8, t)) // 8) * 8
 
 
+def _auto_block(t: int) -> int:
+    """Default block size for sequence length ``t``: the largest tuned
+    tile whose padding overhead (T rounds up to a block multiple; padded
+    rows are masked but still computed) stays under 12.5%.  512 is the
+    measured v5e optimum at large T (2.6x over 128 at T=8192 — bigger
+    tiles amortize the logsumexp bookkeeping over more MXU work; 1024
+    regresses, 2048 exceeds VMEM); odd lengths degrade gracefully
+    (e.g. T=640 -> 128, zero padding) instead of paying up to 2.5x
+    padded FLOPs."""
+    for b in (512, 256, 128):
+        if -(-t // b) * b <= t * 1.125:
+            return b
+    return 128
+
+
 def _to_bhd(x: Array, block: int) -> Array:
     """(B, T, H, D) -> (B*H, T_padded, D_padded): T padded to the block
     multiple, D to the 128 lane width (zero padding is inert in q.k^T
@@ -553,12 +568,18 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
-                    sm_scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128,
+                    sm_scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     precision: Optional[jax.lax.Precision] = None,
                     fused_backward: bool = True) -> Array:
     """Flash attention over (batch, T, heads, d_head) q/k/v.
+
+    ``block_q``/``block_k`` default to an auto-tuned size (see
+    ``_auto_block``: 512 at large T — measured 2.6x over 128 for
+    fwd+fused-bwd at T=8192 on v5e — smaller when T would pad
+    wastefully).
 
     ``interpret=None`` auto-selects: compiled Mosaic on TPU, Pallas
     interpret mode elsewhere (slow but exact — the CPU-mesh test path).
@@ -575,7 +596,9 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     T = q.shape[1]
-    block_q = _clamp_block(block_q, T)
-    block_k = _clamp_block(block_k, T)
+    block_q = _clamp_block(block_q if block_q is not None
+                           else _auto_block(T), T)
+    block_k = _clamp_block(block_k if block_k is not None
+                           else _auto_block(T), T)
     return _flash_core(q, k, v, causal, scale, block_q, block_k,
                        bool(interpret), precision, bool(fused_backward))

@@ -46,10 +46,10 @@ def main():
             # one compiled executable per distinct input shape; the
             # repeated batch-8 call reuses its entry (runner-side lookup
             # — the C++ cache's hit counter only moves on re-COMPILES)
+            stats = runner.cache_stats()
             print(f"batch {batch}: native output {y.shape}, "
-                  f"compiled shapes {len(runner._execs)}, "
-                  f"client cache {runner.cache_stats()}")
-        assert len(runner._execs) == 2   # 2 shapes, 3 calls
+                  f"client cache {stats}")
+        assert runner.cache_stats()["entries"] == 2   # 2 shapes, 3 calls
         jax_out = np.asarray(net.output(x))
         np.testing.assert_allclose(y, jax_out, rtol=2e-2, atol=2e-3)
     print("native output matches the JAX path")

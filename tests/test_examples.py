@@ -42,3 +42,13 @@ def test_word2vec_example():
     mod = _run("word2vec_text.py")
     w2v = mod["main"]()   # asserts 'queen' ranks in nearest-to-'king'
     assert w2v.has_word("king")
+
+
+@pytest.mark.parametrize("name", ["lenet_mnist.py", "char_lstm.py",
+                                  "ui_dashboard.py",
+                                  "native_inference.py"])
+def test_heavy_examples_at_least_compile(name):
+    """The heavy scripts don't train in CI, but they must stay
+    syntactically valid and importable-shaped (bit-rot guard)."""
+    import py_compile
+    py_compile.compile(os.path.join(EXAMPLES, name), doraise=True)
