@@ -249,10 +249,11 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
 
 
 def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
-               hidden: int = 256, steps: int = 200, trials: int = 3,
-               pipeline: int = 4) -> dict:
+               hidden: int = 256, steps: int = 800, trials: int = 3,
+               pipeline: int = 3) -> dict:
     """GravesLSTM char-RNN tBPTT step (BASELINE config #3): lax.scan over
-    time inside the jitted train step."""
+    time inside the jitted train step.  800 steps/dispatch measured best
+    (round 4: 200→4.75M, 400→6.09M, 800→6.35M, 1600→6.26M chars/s)."""
     import jax
     import jax.numpy as jnp
 
@@ -332,8 +333,8 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
 
 
 def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
-                   negative: int = 5, steps: int = 200,
-                   trials: int = 3, pipeline: int = 4) -> dict:
+                   negative: int = 5, steps: int = 800,
+                   trials: int = 3, pipeline: int = 2) -> dict:
     """Word2Vec skip-gram negative-sampling kernel throughput (BASELINE
     config #4), pairs/sec through the XLA scatter-add kernel (the
     ``AggregateSkipGram`` role).  The step loop runs on-chip via

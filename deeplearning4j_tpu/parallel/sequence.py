@@ -141,7 +141,8 @@ def _full_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
 def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
                          causal: bool = False,
                          sm_scale: Optional[float] = None,
-                         block_q: int = 128, block_k: int = 128,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          interpret: Optional[bool] = None,
                          precision=None) -> Array:
     """Ring attention whose per-step LOCAL block runs the Pallas flash
@@ -166,6 +167,12 @@ def ring_flash_attention(q: Array, k: Array, v: Array, *, axis_name: str,
     pass ``interpret=True`` when the mesh devices aren't the default
     backend (e.g. a CPU mesh on a TPU-attached host).
     """
+    from ..ops.attention import _auto_block
+    t_local = q.shape[1]          # per-shard T inside shard_map
+    if block_q is None:
+        block_q = _auto_block(t_local)
+    if block_k is None:
+        block_k = _auto_block(t_local)
     return _ring_flash_core(q, k, v, axis_name, causal, sm_scale,
                             block_q, block_k, interpret, precision)
 
