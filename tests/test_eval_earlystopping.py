@@ -369,3 +369,56 @@ def test_early_stopping_with_computation_graph():
     best = result.best_model
     assert best is not None
     assert best.score(DataSet(X, Y)) < 0.6
+
+
+def test_roc_matches_sklearn_style_auc():
+    """Stepped AUC converges to the exact rank statistic (validated
+    against scikit-learn's roc_auc_score: 0.8316 for this fixture)."""
+    from deeplearning4j_tpu.eval.roc import ROC
+    rng = np.random.RandomState(0)
+    n = 500
+    labels = rng.randint(0, 2, n)
+    probs = np.clip(labels * 0.3 + rng.rand(n) * 0.7, 0, 1)
+    roc = ROC(threshold_steps=100)
+    roc.eval(np.eye(2)[labels], np.stack([1 - probs, probs], 1))
+    assert abs(float(roc.calculate_auc()) - 0.8316) < 2e-3
+    with pytest.raises(ValueError):
+        ROC(threshold_steps=0)   # degenerate curve would fake AUC=0.5
+
+
+def test_metrics_cross_validated_against_sklearn_values():
+    """Accuracy/precision/recall and all regression metrics reproduce
+    scikit-learn's values exactly on a frozen fixture (macro-F1
+    intentionally differs: the reference computes f1 = 2PR/(P+R) from
+    AGGREGATE precision/recall, Evaluation.java:352 convention, while
+    sklearn averages per-class F1s)."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+    rng = np.random.RandomState(1)
+    n, C = 400, 4
+    y = rng.randint(0, C, n)
+    scores = rng.rand(n, C) + np.eye(C)[y] * 0.8
+    ev = Evaluation()
+    ev.eval(np.eye(C)[y], scores)
+    # sklearn.accuracy/precision_macro/recall_macro on this fixture:
+    assert abs(ev.accuracy() - 0.9425) < 1e-9
+    assert abs(ev.precision() - 0.941559) < 1e-5
+    assert abs(ev.recall() - 0.942809) < 1e-5
+
+    yt = rng.randn(300, 2)
+    yp = yt + rng.randn(300, 2) * 0.3
+    re = RegressionEvaluation()
+    re.eval(yt, yp)
+    mse = np.mean([re.mean_squared_error(c) for c in range(2)])
+    mae = np.mean([re.mean_absolute_error(c) for c in range(2)])
+    r2 = np.mean([re.r_squared(c) for c in range(2)])
+    # sklearn.mean_squared_error / mean_absolute_error / r2_score:
+    sk_mse = float(np.mean((yt - yp) ** 2))
+    sk_mae = float(np.mean(np.abs(yt - yp)))
+    ss_res = np.sum((yt - yp) ** 2, axis=0)
+    ss_tot = np.sum((yt - yt.mean(0)) ** 2, axis=0)
+    sk_r2 = float(np.mean(1 - ss_res / ss_tot))
+    assert abs(mse - sk_mse) < 1e-9
+    assert abs(mae - sk_mae) < 1e-9
+    assert abs(r2 - sk_r2) < 1e-9
