@@ -162,8 +162,8 @@ def _run_scan_bench(net, feats, labels, steps: int, pipeline: int,
     return elapsed, cost
 
 
-def bench_lenet(batch: int = 256, steps: int = 1600, trials: int = 3,
-                pipeline: int = 4) -> dict:
+def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
+                pipeline: int = 3) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -181,9 +181,18 @@ def bench_lenet(batch: int = 256, steps: int = 1600, trials: int = 3,
     # scan, not host->device transfer over the tunnel
     # transfer the n distinct batches once (~6 MB), expand to the (steps,
     # B, ...) stack by an ON-DEVICE gather — shipping the redundant copies
-    # through the tunnel would cost ~200x the transfer at steps=1600
+    # through the tunnel would cost ~400x the transfer at steps=3200
+    # (round-4 depth sweep: 1600-step 1.52M / 3200-step 1.59M / 6400-step
+    # 1.55M samples/s; 3200 amortizes the last dispatch overhead)
+    # cast the base pool to the compute dtype BEFORE the on-device
+    # gather, so the staged (steps, B, ...) stack is bf16 (~1.3 GB at
+    # 3200 steps) rather than f32 (~2.6 GB) — same policy as the
+    # ResNet bench's staging
+    in_dtype = (jnp.bfloat16 if conf.conf.compute_dtype == "bfloat16"
+                else jnp.float32)
     f_dev = jnp.asarray(np.stack(
-        [features[i * batch:(i + 1) * batch] for i in range(n)]))
+        [features[i * batch:(i + 1) * batch]
+         for i in range(n)])).astype(in_dtype)
     l_dev = jnp.asarray(np.stack(
         [labels[i * batch:(i + 1) * batch] for i in range(n)]))
     idx = jnp.asarray([i % n for i in range(steps)])
