@@ -119,6 +119,29 @@ _ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
 }
 
 
+_BUILTIN_ACTIVATIONS = frozenset(_ACTIVATIONS)
+
+
+def register(name: str, fn: Callable[[Array], Array],
+             overwrite: bool = False) -> None:
+    """Register a user-defined activation under a (case-insensitive)
+    name so layer configs can refer to it like any built-in (reference
+    custom-``IActivation`` extension point, ``CustomActivation`` in the
+    reference test tier).  The function must be jax-traceable; its
+    gradient comes from autodiff.
+
+    Shadowing a BUILT-IN name silently changes every model in the
+    process (including ``from_json`` restores), so it raises unless
+    ``overwrite=True`` is explicit."""
+    key = name.lower()
+    if key in _BUILTIN_ACTIVATIONS and not overwrite:
+        raise ValueError(
+            f"'{key}' is a built-in activation; registering over it "
+            "would change every model in this process — pass "
+            "overwrite=True if that is really intended")
+    _ACTIVATIONS[key] = fn
+
+
 def get(name: str) -> Callable[[Array], Array]:
     """Resolve an activation by (case-insensitive) name.
 
