@@ -257,3 +257,118 @@ class ProfilerListener(TrainingListener):
                 "mean_ms": float(arr.mean()),
                 "p50_ms": float(np.percentile(arr, 50)),
                 "p95_ms": float(np.percentile(arr, 95))}
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic training checkpoints with retention and async writes
+    (the later-reference ``CheckpointListener``; at 0.7.3 the only
+    checkpointing is the early-stopping savers, so this is the
+    iteration-frequency tier a long TPU run needs).
+
+    Every ``save_every_n_iterations`` iterations (or at every epoch end
+    with ``save_every_epochs``), the FULL training state — conf, params,
+    updater state (``ModelSerializer`` zip, so ``restore_*`` resumes
+    bit-exactly) — is written to ``checkpoint_<iter>.zip`` in ``dir``.
+    Writes go tmpfile-then-atomic-rename, so a crash mid-write never
+    corrupts the latest checkpoint; ``keep_last`` bounds disk use;
+    ``async_write=True`` serializes on the calling thread (params are
+    fetched synchronously — tiny vs a TPU step) but does file IO on a
+    background thread so the training loop never blocks on disk."""
+
+    def __init__(self, checkpoint_dir: str,
+                 save_every_n_iterations: int = 0,
+                 save_every_epochs: int = 0, keep_last: int = 3,
+                 async_write: bool = True):
+        import os
+        if save_every_n_iterations <= 0 and save_every_epochs <= 0:
+            raise ValueError("set save_every_n_iterations and/or "
+                             "save_every_epochs")
+        self.dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.every_iter = int(save_every_n_iterations)
+        self.every_epochs = int(save_every_epochs)
+        self.keep_last = max(1, int(keep_last))
+        self.async_write = async_write
+        self._epoch = 0
+        self._last_saved_iter = None   # both triggers firing on one
+        self._pending: dict = {}       # path -> writer thread
+        self._write_errors: list = []  # (path, exception)
+        self.saved: list = []          # checkpoint paths, oldest first
+
+    # ------------------------------------------------------------- hooks
+    def iteration_done(self, model, iteration: int) -> None:
+        if self.every_iter > 0 and iteration % self.every_iter == 0:
+            self._save(model, iteration)
+
+    def on_epoch_end(self, model) -> None:
+        self._epoch += 1
+        if self.every_epochs > 0 and self._epoch % self.every_epochs == 0:
+            self._save(model, model.iteration)
+
+    # ------------------------------------------------------------- write
+    def _save(self, model, iteration: int) -> None:
+        import io
+        import os
+        import threading
+
+        from ...utils.model_serializer import write_model
+
+        if iteration == self._last_saved_iter:
+            return      # iteration AND epoch trigger fired together
+        self._last_saved_iter = iteration
+
+        # serialize NOW (state snapshot) ...
+        buf = io.BytesIO()
+        write_model(model, buf)
+        data = buf.getvalue()
+        path = os.path.join(self.dir, f"checkpoint_{iteration}.zip")
+
+        def write():
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)   # atomic on POSIX
+            except BaseException as e:  # surfaced by flush()
+                self._write_errors.append((path, e))
+
+        if self.async_write:
+            t = threading.Thread(target=write, daemon=True)
+            t.start()
+            self._pending[path] = t
+        else:
+            write()
+            self._raise_write_errors()
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            # join ONLY the evicted checkpoint's writer (it finished long
+            # ago in steady state) — joining everything would serialize
+            # the write we just started
+            t = self._pending.pop(old, None)
+            if t is not None:
+                t.join()
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def _raise_write_errors(self) -> None:
+        if self._write_errors:
+            path, err = self._write_errors[0]
+            self._write_errors = []
+            raise RuntimeError(
+                f"checkpoint write failed for {path}") from err
+
+    def flush(self) -> None:
+        """Join outstanding async writes; raises if any write failed
+        (a silently lost checkpoint would surface as FileNotFoundError
+        at resume time, far from the real cause)."""
+        for t in self._pending.values():
+            t.join()
+        self._pending = {}
+        self._raise_write_errors()
+
+    def last_checkpoint(self) -> "str | None":
+        self.flush()
+        return self.saved[-1] if self.saved else None

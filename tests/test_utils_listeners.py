@@ -147,3 +147,99 @@ def test_model_guesser_on_real_keras_fixture():
     net = load_model_guess(path)
     out = np.asarray(net.output(np.zeros((2, 28, 28, 1), np.float32)))
     assert out.shape == (2, 10)
+
+
+def test_checkpoint_listener_periodic_atomic_resume(tmp_path):
+    """CheckpointListener: periodic zips with retention; the latest
+    checkpoint restores and resumes step-for-step with the live net."""
+    from deeplearning4j_tpu.optimize.listeners.listeners import (
+        CheckpointListener)
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_multi_layer_network)
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater("adam").learning_rate(0.02)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3)).build())
+    net = MultiLayerNetwork(conf).init()
+    ck = CheckpointListener(str(tmp_path), save_every_n_iterations=5,
+                            keep_last=2)
+    net.set_listeners(ck)
+    rng = np.random.RandomState(0)
+    ds = DataSet(rng.randn(16, 6), np.eye(3)[rng.randint(0, 3, 16)])
+    for _ in range(20):
+        net.fit(ds)
+    ck.flush()
+    assert len(ck.saved) == 2                       # retention
+    import os
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["checkpoint_15.zip", "checkpoint_20.zip"]
+    assert not any(f.endswith(".tmp") for f in files)
+
+    again = restore_multi_layer_network(ck.last_checkpoint())
+    assert again.iteration == net.iteration
+    # resume: both nets track exactly (Adam moments restored)
+    for _ in range(3):
+        net.fit(ds)
+        again.fit(ds)
+    np.testing.assert_allclose(np.asarray(again.get_flat_params()),
+                               np.asarray(net.get_flat_params()),
+                               atol=1e-6)
+
+
+def test_checkpoint_listener_epoch_mode(tmp_path):
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.optimize.listeners.listeners import (
+        CheckpointListener)
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater("sgd").learning_rate(0.05)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3)).build())
+    net = MultiLayerNetwork(conf).init()
+    ck = CheckpointListener(str(tmp_path), save_every_epochs=2,
+                            keep_last=5, async_write=False)
+    net.set_listeners(ck)
+    rng = np.random.RandomState(1)
+    it = ListDataSetIterator(
+        DataSet(rng.randn(32, 6), np.eye(3)[rng.randint(0, 3, 32)]), 8)
+    net.fit(it, epochs=4)
+    assert len(ck.saved) == 2                       # epochs 2 and 4
+    with pytest.raises(ValueError):
+        CheckpointListener(str(tmp_path))           # no frequency set
+
+
+def test_checkpoint_listener_dual_trigger_dedups_and_errors_surface(
+        tmp_path):
+    """Iteration + epoch triggers firing at the same step save ONCE; a
+    failed write surfaces at flush() instead of a phantom checkpoint."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.optimize.listeners.listeners import (
+        CheckpointListener)
+
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater("sgd").learning_rate(0.05)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3)).build())
+    net = MultiLayerNetwork(conf).init()
+    # 4 batches/epoch, save every 4 iters AND every epoch: same step
+    ck = CheckpointListener(str(tmp_path), save_every_n_iterations=4,
+                            save_every_epochs=1, keep_last=10,
+                            async_write=False)
+    net.set_listeners(ck)
+    rng = np.random.RandomState(1)
+    it = ListDataSetIterator(
+        DataSet(rng.randn(32, 6), np.eye(3)[rng.randint(0, 3, 32)]), 8)
+    net.fit(it, epochs=2)
+    assert ck.saved == sorted(set(ck.saved))        # no duplicates
+    assert len(ck.saved) == 2                       # iters 4 and 8, once
+
+    bad = CheckpointListener(os.path.join(str(tmp_path), "sub"),
+                             save_every_n_iterations=1)
+    os.rmdir(os.path.join(str(tmp_path), "sub"))    # break the target dir
+    bad.iteration_done(net, 1)
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        bad.flush()
