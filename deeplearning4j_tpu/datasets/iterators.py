@@ -237,6 +237,26 @@ class AsyncDataSetIterator(DataSetIterator):
         finally:
             self._queue.put(self._END)
 
+    def _drain_python_worker(self) -> None:
+        """Drain the bounded queue so a blocked producer can exit, then
+        join it — otherwise switching paths leaks the thread (and its
+        reference to the underlying iterator) for the process lifetime.
+
+        Timed gets re-checking ``is_alive``: a plain ``get()`` could
+        block forever in the race where the consumer already took the
+        ``_END`` sentinel but the producer thread has not yet died."""
+        t = self._thread
+        if t is not None:
+            while t.is_alive():
+                try:
+                    self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+            t.join()
+        self._thread = None
+        self._queue = queue.Queue(maxsize=self._size)
+        self._error = None
+
     def reset(self) -> None:
         # conditions can change between epochs (preprocessor attached,
         # dataset swapped) — re-evaluate which path serves the next epoch
@@ -246,6 +266,9 @@ class AsyncDataSetIterator(DataSetIterator):
             self.close()
             self._native_left = 0
         if self.native:
+            # a Python-path epoch may have run before this native one:
+            # retire its worker thread rather than leaking it
+            self._drain_python_worker()
             full = self._batches_per_epoch()
             if self._native_pf is not None and self._native_left not in (
                     0, full):
@@ -256,14 +279,8 @@ class AsyncDataSetIterator(DataSetIterator):
                 self._ring_epoch += 1
             self._native_left = full
             return
-        if self._thread is not None and self._thread.is_alive():
-            # Drain so the producer can exit, then join.
-            while self._queue.get() is not self._END:
-                pass
-            self._thread.join()
+        self._drain_python_worker()
         self._under.reset()
-        self._queue = queue.Queue(maxsize=self._size)
-        self._error = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 

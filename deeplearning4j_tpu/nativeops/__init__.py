@@ -508,12 +508,23 @@ class PjrtClient:
         device-buffer id (int) or a host numpy array — the hot inference
         path transfers only the activation arguments."""
         codes = self._dtype_codes()
-        n = len(arg_spec)
+        spec = []
+        for a in arg_spec:
+            # bool subclasses int: True would silently rebind to buffer
+            # id 1 (typically the first uploaded parameter) — reject, and
+            # require host operands to arrive as arrays
+            if isinstance(a, bool) or (isinstance(a, np.generic)
+                                       and not isinstance(a, np.integer)):
+                raise TypeError(
+                    "execute_mixed arg_spec entries must be device-buffer"
+                    f" ids (int) or numpy arrays; got {type(a).__name__}."
+                    " Wrap host scalars with np.asarray(x)")
+            spec.append(int(a) if isinstance(a, (int, np.integer))
+                        else np.ascontiguousarray(a))
+        n = len(spec)
         buf_ids = (ctypes.c_int64 * n)(
-            *[int(a) if isinstance(a, (int, np.integer)) else -1
-              for a in arg_spec])
-        host = [np.ascontiguousarray(a) for a in arg_spec
-                if not isinstance(a, (int, np.integer))]
+            *[a if isinstance(a, int) else -1 for a in spec])
+        host = [a for a in spec if not isinstance(a, int)]
         n_host = len(host)
         host_ptrs = (ctypes.c_void_p * max(1, n_host))(
             *[a.ctypes.data_as(ctypes.c_void_p) for a in host])
@@ -541,7 +552,14 @@ class PjrtClient:
                  out_size: int,
                  compile_options: Optional[bytes] = None) -> np.ndarray:
         """Compile + execute a StableHLO module with flat f32 vector
-        inputs of equal length; returns the flat f32 output."""
+        inputs of equal length; returns the flat f32 output.
+
+        Every distinct program is kept in the executable cache so
+        repeat calls skip compilation.  Long-lived clients streaming
+        MANY distinct programs through this entry point must call
+        :meth:`cache_clear` periodically (check :meth:`cache_stats`
+        ``entries``), or device/host memory grows with the number of
+        distinct programs compiled."""
         ins = [np.ascontiguousarray(a, np.float32).ravel()
                for a in inputs]
         n = ins[0].size

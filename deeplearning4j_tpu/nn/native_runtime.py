@@ -87,10 +87,21 @@ class NativeModelRunner:
         # keep_unused: params not used at inference (e.g. pretrain-only
         # state) must STAY as program operands, or the buffer-id ->
         # operand mapping below would shift
-        if len(self._execs) >= self._max_shapes and self._owns_client:
+        if len(self._execs) >= self._max_shapes:
             # bound executable memory under shape churn (the reference's
             # cuDNN caches are bounded per layer; here per runner)
-            self._client.cache_clear()
+            if self._owns_client:
+                self._client.cache_clear()
+            else:
+                # a SHARED client may hold other runners' executables —
+                # only drop this runner's references (ids stay valid in
+                # the shared cache until its owner clears it)
+                import warnings
+                warnings.warn(
+                    "NativeModelRunner hit max_shapes on a shared "
+                    "PjrtClient: dropping local executable refs; the "
+                    "shared cache retains them until its owner calls "
+                    "cache_clear()", RuntimeWarning, stacklevel=2)
             self._execs.clear()
         lowered = jax.jit(fwd, keep_unused=True).lower(self._leaf_avals,
                                                        *avals)

@@ -323,9 +323,13 @@ class CheckpointListener(TrainingListener):
         data = buf.getvalue()
         path = os.path.join(self.dir, f"checkpoint_{iteration}.zip")
 
+        # unique tmp per writer: two checkpoints of the SAME iteration in
+        # one listener lifetime (restore+retrain, fit after iteration
+        # reset) must not interleave partial writes on one tmp file
+        tmp = f"{path}.tmp.{len(self.saved)}-{id(data)}"
+
         def write():
             try:
-                tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
                 os.replace(tmp, path)   # atomic on POSIX
@@ -333,12 +337,17 @@ class CheckpointListener(TrainingListener):
                 self._write_errors.append((path, e))
 
         if self.async_write:
+            prior = self._pending.get(path)
+            if prior is not None:
+                prior.join()     # same-path re-write: serialize, last wins
             t = threading.Thread(target=write, daemon=True)
             t.start()
             self._pending[path] = t
         else:
             write()
             self._raise_write_errors()
+        if path in self.saved:       # re-checkpointed iteration: keep one
+            self.saved.remove(path)  # retention slot, refresh recency
         self.saved.append(path)
         while len(self.saved) > self.keep_last:
             old = self.saved.pop(0)

@@ -44,6 +44,10 @@ struct ExecEntry {
   // of pinned entries until the last execution unpins
   int pins = 0;
   bool dead = false;
+  // full cache key (program text ‖ '\0' ‖ compile options), compared on
+  // every hash hit: a 64-bit hash collision must miss, never silently
+  // execute the wrong program
+  std::string key_text;
 };
 
 struct DeviceBuf {
@@ -57,11 +61,12 @@ struct ShimClient {
   const PJRT_Api* api = nullptr;
   PJRT_Client* client = nullptr;
   // Executable cache: FNV-1a hash of (program text ‖ compile options) →
-  // index into `execs`.  Input/output shapes and dtypes are part of the
-  // StableHLO program text (static shapes), so the program hash subsumes
-  // the (shapes, dtype) part of the cache key.
+  // bucket of exec ids whose stored key_text is compared on lookup
+  // (hash collisions become misses, not wrong-program executions).
+  // Input/output shapes and dtypes are part of the StableHLO program
+  // text (static shapes), so the key subsumes (shapes, dtype).
   std::mutex mu;
-  std::unordered_map<uint64_t, int64_t> cache;  // program hash -> exec id
+  std::unordered_map<uint64_t, std::vector<int64_t>> cache;
   std::unordered_map<int64_t, ExecEntry> execs;
   int64_t next_exec_id = 0;
   int64_t hits = 0;
@@ -352,13 +357,32 @@ int64_t dl4j_pjrt_compile_cached(void* handle, const char* mlir_code,
   if (compile_options != nullptr && compile_options_size > 0) {
     key = fnv1a(compile_options, (size_t)compile_options_size, key);
   }
+  // the full key, stored per entry and compared on every hash hit
+  std::string key_text(mlir_code, code_size);
+  key_text.push_back('\0');
+  if (compile_options != nullptr && compile_options_size > 0) {
+    key_text.append(compile_options, (size_t)compile_options_size);
+  }
+  // caller must hold shim->mu
+  auto find_verified = [shim, key, &key_text]() -> int64_t {
+    auto it = shim->cache.find(key);
+    if (it == shim->cache.end()) return -1;
+    for (int64_t id : it->second) {
+      auto eit = shim->execs.find(id);
+      if (eit != shim->execs.end() && !eit->second.dead &&
+          eit->second.key_text == key_text) {
+        return id;
+      }
+    }
+    return -1;
+  };
   {
     std::lock_guard<std::mutex> lock(shim->mu);
-    auto it = shim->cache.find(key);
-    if (it != shim->cache.end()) {
+    int64_t id = find_verified();
+    if (id >= 0) {
       ++shim->hits;
       if (was_hit != nullptr) *was_hit = 1;
-      return it->second;
+      return id;
     }
   }
 
@@ -446,18 +470,19 @@ int64_t dl4j_pjrt_compile_cached(void* handle, const char* mlir_code,
   }
 
   std::lock_guard<std::mutex> lock(shim->mu);
-  auto it = shim->cache.find(key);
-  if (it != shim->cache.end()) {
+  int64_t existing = find_verified();
+  if (existing >= 0) {
     // Lost a compile race; keep the first entry, destroy our duplicate.
     destroy_exec_entry(api, entry);
     ++shim->hits;
     if (was_hit != nullptr) *was_hit = 1;
-    return it->second;
+    return existing;
   }
   ++shim->misses;
   int64_t id = shim->next_exec_id++;
+  entry.key_text = std::move(key_text);
   shim->execs.emplace(id, entry);
-  shim->cache.emplace(key, id);
+  shim->cache[key].push_back(id);
   return id;
 }
 
