@@ -306,6 +306,120 @@ class ComputationGraph:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
 
+    @functools.cached_property
+    def _gather_train_step(self):
+        """Device-cached-epoch graph train step: ``lax.scan`` over
+        (S, B) index rows gathering each minibatch from HBM-resident
+        per-input dataset arrays (see
+        ``MultiLayerNetwork._gather_train_step`` — per-epoch
+        host->device traffic is one int32 index array)."""
+
+        def multi(params, updater_state, net_state, iteration, data_fs,
+                  data_ls, idx, base_rng):
+            def body(carry, idx_row):
+                p, u, s, it = carry
+                f = [jnp.take(d, idx_row, axis=0) for d in data_fs]
+                l = [jnp.take(d, idx_row, axis=0) for d in data_ls]
+                rng = jax.random.fold_in(base_rng, it)
+                (data_loss, (new_s, _)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(
+                        p, s, f, l, None, None, rng, True)
+                new_p, new_u = self._apply_updates(p, u, grads, it)
+                score = data_loss + self._reg_score(p)
+                return (new_p, new_u, new_s, it + 1), score
+
+            init = (params, updater_state, net_state,
+                    jnp.asarray(iteration, jnp.int32))
+            (params, updater_state, net_state, _), scores = jax.lax.scan(
+                body, init, idx)
+            return params, updater_state, net_state, scores
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def _fit_device_cached(self, source, epochs: int):
+        """Graph twin of ``MultiLayerNetwork._fit_device_cached``:
+        ``source`` is a vetted ``ListDataSetIterator`` (single-input
+        DataSets); the dataset lives on device across epochs and each
+        epoch is one gather-scan dispatch per batch-shape."""
+        from . import ingest
+
+        data_fs = (jnp.asarray(np.asarray(source._ds.features)),)
+        data_ls = (jnp.asarray(np.asarray(source._ds.labels)),)
+        replay = ingest.ScoreReplayer(self)
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            order = ingest.epoch_order(source)
+            for idx in ingest.epoch_index_batches(order, source._batch):
+                (self.params, self.updater_state, self.net_state,
+                 scores) = self._gather_train_step(
+                    self.params, self.updater_state, self.net_state,
+                    self.iteration, data_fs, data_ls, jnp.asarray(idx),
+                    self._rng_key)
+                replay.add(self.iteration, scores)
+                self.iteration += idx.shape[0]
+                self.last_batch_size = idx.shape[1]
+            if self.listeners:
+                replay.replay()
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+        replay.finish()
+        return self
+
+    def _fit_windowed(self, iterator, epochs: int, window: int):
+        """Graph twin of ``MultiLayerNetwork._fit_windowed``: stream
+        (Multi)DataSets in multi-batch windows, host stacking and
+        transfer overlapping the previous window's on-chip scan."""
+        from . import ingest
+
+        replay = ingest.ScoreReplayer(self)
+
+        def dispatch(buf):
+            features, labels, fms, lms = ingest.stack_multi_window(buf)
+            (self.params, self.updater_state, self.net_state,
+             scores) = self._multi_train_step(
+                self.params, self.updater_state, self.net_state,
+                self.iteration,
+                [jnp.asarray(f) for f in features],
+                [jnp.asarray(l) for l in labels],
+                None if fms is None else [
+                    None if m is None else jnp.asarray(m) for m in fms],
+                None if lms is None else [
+                    None if m is None else jnp.asarray(m) for m in lms],
+                self._rng_key)
+            replay.add(self.iteration, scores)
+            self.iteration += len(buf)
+            self.last_batch_size = buf[0].num_examples()
+
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            buf, sig = [], None
+            for ds in iterator:
+                mds = _as_multi(ds)
+                s = ingest.multi_window_signature(mds)
+                if buf and (s != sig or len(buf) >= window):
+                    dispatch(buf)
+                    buf = []
+                sig = s
+                buf.append(mds)
+            if buf:
+                dispatch(buf)
+            if self.listeners:
+                replay.replay()
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+        replay.finish()
+        return self
+
     def fit_scan(self, batches) -> "np.ndarray":
         """Fit a list of same-shaped DataSet/MultiDataSet minibatches in one
         device dispatch (scan-based inner loop); returns per-step scores.
@@ -558,13 +672,24 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, epochs: int = 1) -> "ComputationGraph":
+    def fit(self, data, labels=None, epochs: int = 1,
+            ingest: str = "auto", window: int = 16) -> "ComputationGraph":
         """Train (reference ``fit`` variants ``:650-810``).  ``data`` may be
         a (Multi)DataSet, an iterator of them, or features with ``labels``.
 
         With ``conf.pretrain=True`` the first call pretrains every
         pretrainable layer vertex; ``conf.backprop=False`` skips the
-        supervised phase (reference ``fit:740`` + ``pretrain:510``)."""
+        supervised phase (reference ``fit:740`` + ``pretrain:510``).
+
+        ``ingest``/``window``: iterator data-path selection, same
+        semantics as :meth:`MultiLayerNetwork.fit` — ``"auto"`` picks
+        the device-resident epoch cache when the dataset fits HBM, else
+        windowed double-buffered staging; listeners fire by exact
+        per-step score replay."""
+        if ingest not in ("auto", "cache", "window", "batch"):
+            raise ValueError(
+                f"unknown ingest mode {ingest!r}; expected 'auto', "
+                "'cache', 'window', or 'batch'")
         self.init()
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
@@ -584,6 +709,21 @@ class ComputationGraph:
             self._pretrain_done = True
         if not getattr(self.conf, "backprop", True):
             return self
+        if (iterator is not None and ingest != "batch"
+                and self._solver is None
+                and getattr(self.conf, "backprop_type",
+                            "standard") != "tbptt"
+                and self.conf.conf.num_iterations == 1):
+            from . import ingest as ingest_mod
+            if ingest in ("auto", "cache"):
+                source = ingest_mod.cacheable_source(iterator)
+                if source is not None:
+                    return self._fit_device_cached(source, epochs)
+                if ingest == "cache":
+                    raise ValueError(
+                        "ingest='cache' but the iterator is not "
+                        "device-cacheable (see nn/ingest.py eligibility)")
+            return self._fit_windowed(iterator, epochs, window)
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
