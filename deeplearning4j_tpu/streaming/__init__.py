@@ -23,6 +23,8 @@ See :mod:`.pipeline` for :class:`StreamingPipeline` and
 :mod:`.conversion` for the record->array converter SPI.
 """
 
+from .broker import (BrokerRecordSource, StreamBroker, StreamConsumer,
+                     StreamProducer)
 from .conversion import (CsvRecordConverter, DictRecordConverter,
                          RecordConverter)
 from .pipeline import StreamingPipeline
@@ -32,5 +34,6 @@ from .sources import (FileTailRecordSource, InMemoryRecordSource,
 __all__ = [
     "RecordConverter", "CsvRecordConverter", "DictRecordConverter",
     "StreamingPipeline", "RecordSource", "InMemoryRecordSource",
-    "FileTailRecordSource", "SocketRecordSource",
+    "FileTailRecordSource", "SocketRecordSource", "StreamBroker",
+    "StreamProducer", "StreamConsumer", "BrokerRecordSource",
 ]

@@ -145,5 +145,11 @@ class StreamingPipeline:
                         xf, yf = xf[idx], yf[idx]
                     self.net.fit(DataSet(xf, yf))
             self.batches_processed += 1
+            # offset-tracking sources (BrokerRecordSource) commit the
+            # processed prefix here: commit-after-process gives the
+            # at-least-once resume contract of the reference's
+            # Kafka -> Spark Streaming pipeline
+            if hasattr(self.source, "on_batch_processed"):
+                self.source.on_batch_processed()
         except Exception as e:
             self.errors.append(e)
