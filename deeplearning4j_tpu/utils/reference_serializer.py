@@ -1,0 +1,549 @@
+"""Reference-format model-file interop.
+
+Reads/writes the reference implementation's on-disk model contract
+(``util/ModelSerializer.java:43-148``): a zip holding
+
+- ``configuration.json`` — Jackson JSON of ``MultiLayerConfiguration``
+  (wrapper-object layer typing, ``nn/conf/layers/Layer.java:46-64``
+  subtype names: "dense", "output", "convolution", "subsampling", ...),
+- ``coefficients.bin`` — ``Nd4j.write`` of the network's single flat
+  parameter view,
+- ``updaterState.bin`` — ``Nd4j.write`` of the updater state view
+  (absent for stateless updaters, matching ``writeModel``'s
+  length-0 skip).
+
+**Binary framing** (documented reconstruction of the nd4j-0.7 line's
+``Nd4j.write(INDArray, DataOutputStream)`` + ``BaseDataBuffer.write``;
+no reference-written fixtures exist in this environment, so the
+format below is the interop contract this module both writes and
+reads, golden-tested against hand-built files in
+``tests/test_reference_serializer.py``):
+
+.. code-block:: text
+
+    int32  BE   shapeInfo length L (= rank*2 + 4)
+    L x int32   shapeInfo: [rank, shape.., stride.., offset,
+                            elementWiseStride, order-char ('c'/'f')]
+    UTF         allocation mode name (Java modified-UTF8: u16 BE length
+                + bytes), e.g. "DIRECT"
+    int32  BE   element count
+    UTF         data type name: "FLOAT" | "DOUBLE"
+    count x f32/f64 BE   elements
+
+**Flat parameter order** (``MultiLayerNetwork.params()``): layer by
+layer, each layer's params in its ParamInitializer order (W then b,
+``nn/params/DefaultParamInitializer.java``), each array flattened in
+'f' (column-major) order — ``WeightInitUtil.DEFAULT_WEIGHT_INIT_ORDER``.
+Dense W is (nIn, nOut); convolution W is (out, in, kh, kw) (this
+package stores HWIO and transposes here).  Updater state concatenates,
+per layer/param, the rule's slots in DL4J order (NESTEROVS: v;
+ADAM: m then v; ADAGRAD/RMSPROP: v), each 'f'-flattened like its param.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+CONFIG_JSON = "configuration.json"
+COEFFICIENTS_BIN = "coefficients.bin"
+UPDATER_BIN = "updaterState.bin"
+
+# --------------------------------------------------------- nd4j binary IO
+
+
+def _write_utf(fh, s: str) -> None:
+    data = s.encode("utf-8")
+    fh.write(struct.pack(">H", len(data)) + data)
+
+
+def _read_utf(fh) -> str:
+    (n,) = struct.unpack(">H", fh.read(2))
+    return fh.read(n).decode("utf-8")
+
+
+def nd4j_write_array(arr: np.ndarray, fh) -> None:
+    """Serialize one array in the reference's ``Nd4j.write`` framing
+    (row-vector layout, like the flat views the reference writes)."""
+    arr = np.asarray(arr)
+    flat = arr.reshape(1, -1)
+    rank = 2
+    shape = [1, flat.shape[1]]
+    stride = [1, 1]                      # 'f'-order row vector strides
+    dtype_name = "DOUBLE" if arr.dtype == np.float64 else "FLOAT"
+    np_dtype = ">f8" if dtype_name == "DOUBLE" else ">f4"
+    info = [rank] + shape + stride + [0, 1, ord("f")]
+    fh.write(struct.pack(">i", len(info)))
+    fh.write(struct.pack(f">{len(info)}i", *info))
+    _write_utf(fh, "DIRECT")
+    fh.write(struct.pack(">i", flat.size))
+    _write_utf(fh, dtype_name)
+    fh.write(flat.astype(np_dtype).tobytes())
+
+
+def nd4j_read_array(fh) -> np.ndarray:
+    """Parse one ``Nd4j.write``-framed array; returns a 1-D f32/f64
+    numpy array in logical (row-major) element order."""
+    (info_len,) = struct.unpack(">i", fh.read(4))
+    info = struct.unpack(f">{info_len}i", fh.read(4 * info_len))
+    rank = info[0]
+    shape = list(info[1:1 + rank])
+    order = chr(info[info_len - 1]) if info[info_len - 1] in (99, 102) \
+        else "c"
+    _read_utf(fh)                        # allocation mode: ignored
+    (count,) = struct.unpack(">i", fh.read(4))
+    dtype_name = _read_utf(fh)
+    np_dtype = {">f4": ">f4", "FLOAT": ">f4",
+                "DOUBLE": ">f8"}.get(dtype_name, ">f4")
+    data = np.frombuffer(fh.read(count * int(np_dtype[-1])), np_dtype)
+    if int(np.prod(shape)) == count and order == "f":
+        data = data.reshape(shape, order="F").reshape(-1)
+    return np.ascontiguousarray(data.astype(np_dtype[1:]))
+
+
+# ------------------------------------------------------------ layer maps
+
+_ACT_TO_REF = {
+    "identity": "ActivationIdentity", "sigmoid": "ActivationSigmoid",
+    "tanh": "ActivationTanH", "relu": "ActivationReLU",
+    "leakyrelu": "ActivationLReLU", "softmax": "ActivationSoftmax",
+    "softplus": "ActivationSoftPlus", "elu": "ActivationELU",
+    "cube": "ActivationCube", "hardsigmoid": "ActivationHardSigmoid",
+    "hardtanh": "ActivationHardTanH", "softsign": "ActivationSoftSign",
+    "rationaltanh": "ActivationRationalTanh",
+}
+_ACT_FROM_REF = {v.lower(): k for k, v in _ACT_TO_REF.items()}
+
+_LOSS_TO_REF = {
+    "mcxent": "LossMCXENT", "mse": "LossMSE", "xent": "LossBinaryXENT",
+    "l1": "LossL1", "l2": "LossL2", "mae": "LossMAE",
+    "negativeloglikelihood": "LossNegativeLogLikelihood",
+    "hinge": "LossHinge", "squared_hinge": "LossSquaredHinge",
+    "kld": "LossKLD", "poisson": "LossPoisson",
+    "cosine_proximity": "LossCosineProximity",
+}
+_LOSS_FROM_REF = {v.lower(): k for k, v in _LOSS_TO_REF.items()}
+# legacy string enum (pre-ILossFunction era), e.g. "MCXENT"
+_LOSS_LEGACY = {"mcxent": "mcxent", "mse": "mse", "xent": "xent",
+                "negativeloglikelihood": "negativeloglikelihood",
+                "l1": "l1", "l2": "l2", "squared_loss": "mse",
+                "kl_divergence": "kld", "poisson": "poisson",
+                "cosine_proximity": "cosine_proximity", "hinge": "hinge"}
+
+_UPDATER_TO_REF = {"sgd": "SGD", "adam": "ADAM", "nesterovs": "NESTEROVS",
+                   "adagrad": "ADAGRAD", "rmsprop": "RMSPROP",
+                   "adadelta": "ADADELTA", "none": "NONE"}
+_UPDATER_FROM_REF = {v: k for k, v in _UPDATER_TO_REF.items()}
+
+_WEIGHT_INIT_TO_REF = {
+    "xavier": "XAVIER", "relu": "RELU", "uniform": "UNIFORM",
+    "zero": "ZERO", "distribution": "DISTRIBUTION", "ones": "ONES",
+    "sigmoid_uniform": "SIGMOID_UNIFORM", "normalized": "NORMALIZED",
+    "vi": "VI", "xavier_uniform": "XAVIER_UNIFORM",
+    "xavier_fan_in": "XAVIER_FAN_IN", "relu_uniform": "RELU_UNIFORM",
+}
+_WEIGHT_INIT_FROM_REF = {v: k for k, v in _WEIGHT_INIT_TO_REF.items()}
+
+
+def _layer_types():
+    from ..nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+    from ..nn.layers.core import DenseLayer, OutputLayer
+    return {"dense": DenseLayer, "output": OutputLayer,
+            "convolution": ConvolutionLayer,
+            "subsampling": SubsamplingLayer}
+
+
+def _ref_name_for(layer) -> str:
+    for name, cls in _layer_types().items():
+        if type(layer) is cls:
+            return name
+    raise NotImplementedError(
+        f"reference-format interop supports "
+        f"{sorted(_layer_types())} layers; got "
+        f"{type(layer).__name__}.  Use "
+        f"utils.model_serializer.write_model for the native format.")
+
+
+# ------------------------------------------------------------- writing
+
+
+def _activation_json(act: Optional[str]) -> dict:
+    ref = _ACT_TO_REF.get((act or "identity").lower())
+    if ref is None:
+        raise NotImplementedError(
+            f"activation {act!r} has no reference-enum mapping")
+    return {ref: {}}
+
+
+def _layer_json(layer, updater_conf) -> dict:
+    name = _ref_name_for(layer)
+    body: dict = {
+        "layerName": layer.name,
+        "activationFn": _activation_json(layer.activation),
+        "weightInit": _WEIGHT_INIT_TO_REF.get(
+            (layer.weight_init or "xavier").lower(), "XAVIER"),
+        "biasInit": float(layer.bias_init or 0.0),
+        "dist": None,
+        "learningRate": float(updater_conf.learning_rate),
+        "biasLearningRate": float(updater_conf.learning_rate),
+        "learningRateSchedule": None,
+        "momentum": float(updater_conf.momentum),
+        "momentumSchedule": None,
+        "l1": float(layer.l1 or 0.0), "l2": float(layer.l2 or 0.0),
+        "biasL1": float(layer.l1_bias or 0.0),
+        "biasL2": float(layer.l2_bias or 0.0),
+        "dropOut": float(layer.dropout or 0.0),
+        "updater": _UPDATER_TO_REF.get(updater_conf.updater, "SGD"),
+        "rho": float(updater_conf.rho),
+        "epsilon": float(updater_conf.epsilon),
+        "rmsDecay": float(updater_conf.rms_decay),
+        "adamMeanDecay": float(updater_conf.adam_mean_decay),
+        "adamVarDecay": float(updater_conf.adam_var_decay),
+        "gradientNormalization": "None",
+        "gradientNormalizationThreshold":
+            float(layer.gradient_normalization_threshold),
+    }
+    if name in ("dense", "output", "convolution"):
+        body["nin"] = int(layer.n_in)
+        body["nout"] = int(layer.n_out)
+    if name in ("convolution", "subsampling"):
+        body["kernelSize"] = list(layer.kernel_size)
+        body["stride"] = list(layer.stride)
+        body["padding"] = list(layer.padding)
+    if name == "subsampling":
+        body["poolingType"] = getattr(layer, "pooling_type",
+                                      "max").upper()
+    if name == "output":
+        loss_ref = _LOSS_TO_REF.get((layer.loss or "mcxent").lower())
+        if loss_ref is None:
+            raise NotImplementedError(
+                f"loss {layer.loss!r} has no reference mapping")
+        body["lossFn"] = {loss_ref: {}}
+    return {name: body}
+
+
+def _nhwc_to_nchw_row_perm(h: int, w: int, c: int) -> np.ndarray:
+    """Row permutation taking OUR dense-after-flatten weight rows
+    (flat order h, w, c) to the reference's (flat order c, h, w):
+    ``W_ref = W_ours[perm]``.  The reference flattens NCHW
+    (``CnnToFeedForwardPreProcessor.java``); this package flattens
+    NHWC — the same divergence the Keras importer handles for
+    Theano-ordered Dense weights."""
+    idx = np.arange(h * w * c).reshape(h, w, c)
+    return idx.transpose(2, 0, 1).reshape(-1)
+
+
+def _dense_row_perms(net) -> Dict[int, np.ndarray]:
+    """layer index -> row perm for dense/output layers fed by a
+    CnnToFeedForward preprocessor (flatten-order interop)."""
+    from ..nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+    out: Dict[int, np.ndarray] = {}
+    for i, pp in getattr(net.conf, "input_preprocessors", {}).items():
+        if isinstance(pp, CnnToFeedForwardPreProcessor) and \
+                pp.height and pp.width and pp.channels:
+            if hasattr(net.layers[i], "n_in") and \
+                    net.layers[i].param_order() == ("W", "b"):
+                out[i] = _nhwc_to_nchw_row_perm(pp.height, pp.width,
+                                                pp.channels)
+    return out
+
+
+def _preprocessors_json(net) -> dict:
+    from ..nn.conf.preprocessors import (CnnToFeedForwardPreProcessor,
+                                         FeedForwardToCnnPreProcessor)
+    out = {}
+    for i, pp in getattr(net.conf, "input_preprocessors", {}).items():
+        if isinstance(pp, CnnToFeedForwardPreProcessor):
+            out[str(i)] = {"cnnToFeedForward": {
+                "inputHeight": int(pp.height),
+                "inputWidth": int(pp.width),
+                "numChannels": int(pp.channels)}}
+        elif isinstance(pp, FeedForwardToCnnPreProcessor):
+            out[str(i)] = {"feedForwardToCnn": {
+                "inputHeight": int(pp.height),
+                "inputWidth": int(pp.width),
+                "numChannels": int(pp.channels)}}
+        else:
+            raise NotImplementedError(
+                f"reference-format interop: preprocessor "
+                f"{type(pp).__name__} at index {i} has no reference "
+                f"mapping (supported: CnnToFeedForward, FeedForwardToCnn)")
+    return out
+
+
+def write_reference_model(net, path, save_updater: bool = True) -> None:
+    """Write ``net`` (a MultiLayerNetwork) in the REFERENCE zip layout —
+    ``configuration.json`` + ``coefficients.bin`` (+
+    ``updaterState.bin``), reference schemas throughout (module doc)."""
+    net.init()
+    confs: List[dict] = []
+    for i, layer in enumerate(net.layers):
+        uconf = net._updater_conf(i)
+        confs.append({
+            "layer": _layer_json(layer, uconf),
+            "seed": int(net.conf.conf.seed),
+            "numIterations": int(net.conf.conf.num_iterations),
+            "miniBatch": bool(net.conf.conf.mini_batch),
+            "maxNumLineSearchIterations": 5,
+            "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+            "variables": [f"{p}" for p in layer.param_order()],
+            "stepFunction": None,
+            "useRegularization": bool(layer.l1 or layer.l2),
+            "useDropConnect": False,
+            "minimize": True,
+            "learningRatePolicy": "None",
+        })
+    top = {
+        "backprop": bool(net.conf.backprop),
+        "pretrain": bool(net.conf.pretrain),
+        "backpropType": ("TruncatedBPTT"
+                         if net.conf.backprop_type == "tbptt"
+                         else "Standard"),
+        "tbpttFwdLength": int(net.conf.tbptt_fwd_length or 20),
+        "tbpttBackLength": int(net.conf.tbptt_back_length or 20),
+        "confs": confs,
+        "inputPreProcessors": _preprocessors_json(net),
+        # MultiLayerConfiguration.java:73 — restored so stateful rules
+        # (Adam bias correction) resume at the right step count
+        "iterationCount": int(getattr(net, "iteration", 0)),
+    }
+    coeff = io.BytesIO()
+    nd4j_write_array(_flat_params_f_order(net), coeff)
+    updater_blob = None
+    if save_updater:
+        state = _flat_updater_f_order(net)
+        if state is not None and state.size:
+            buf = io.BytesIO()
+            nd4j_write_array(state, buf)
+            updater_blob = buf.getvalue()
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(CONFIG_JSON, json.dumps(top, indent=2))
+        zf.writestr(COEFFICIENTS_BIN, coeff.getvalue())
+        if updater_blob is not None:
+            zf.writestr(UPDATER_BIN, updater_blob)
+
+
+def _to_ref_layout(layer, name: str, arr: np.ndarray) -> np.ndarray:
+    """Our param array -> the reference's 'f'-flattened layout."""
+    a = np.asarray(arr)
+    if name == "W" and a.ndim == 4:        # HWIO -> (out,in,kh,kw)
+        a = np.transpose(a, (3, 2, 0, 1))
+    return a.reshape(-1, order="F")
+
+
+def _from_ref_layout(layer, name: str, flat: np.ndarray,
+                     shape: Tuple[int, ...]) -> np.ndarray:
+    """Reference 'f'-flattened segment -> our param array of ``shape``."""
+    if name == "W" and len(shape) == 4:
+        ref_shape = (shape[3], shape[2], shape[0], shape[1])
+        a = flat.reshape(ref_shape, order="F")
+        return np.ascontiguousarray(np.transpose(a, (2, 3, 1, 0)))
+    return np.ascontiguousarray(flat.reshape(shape, order="F"))
+
+
+def _flat_params_f_order(net) -> np.ndarray:
+    perms = _dense_row_perms(net)
+    chunks = []
+    for i, layer in enumerate(net.layers):
+        for name in layer.param_order():
+            a = np.asarray(net.params[i][name], np.float32)
+            if name == "W" and i in perms:
+                a = a[perms[i]]          # NHWC-flat rows -> NCHW-flat
+            chunks.append(_to_ref_layout(layer, name, a))
+    return (np.concatenate(chunks) if chunks
+            else np.zeros((0,), np.float32))
+
+
+_UPDATER_SLOTS = {"nesterovs": ("v",), "adam": ("m", "v"),
+                  "adagrad": ("v",), "rmsprop": ("v",)}
+
+
+def _flat_updater_f_order(net) -> Optional[np.ndarray]:
+    chunks = []
+    for i, layer in enumerate(net.layers):
+        uconf = net._updater_conf(i)
+        slots = _UPDATER_SLOTS.get(uconf.updater, ())
+        state = net.updater_state[i]
+        if not slots or not state:
+            continue
+        perms = _dense_row_perms(net)
+        for pname in layer.param_order():
+            for slot in slots:
+                if slot in state and pname in state[slot]:
+                    a = np.asarray(state[slot][pname], np.float32)
+                    if pname == "W" and i in perms:
+                        a = a[perms[i]]
+                    chunks.append(_to_ref_layout(layer, pname, a))
+    if not chunks:
+        return None
+    return np.concatenate(chunks)
+
+
+# ------------------------------------------------------------- reading
+
+
+def _parse_activation(body: dict) -> str:
+    fn = body.get("activationFn")
+    if isinstance(fn, dict) and fn:
+        key = next(iter(fn))
+        key = key.rsplit(".", 1)[-1]         # tolerate @class-style names
+        act = _ACT_FROM_REF.get(key.lower())
+        if act:
+            return act
+    legacy = body.get("activationFunction")
+    if isinstance(legacy, str):
+        return legacy.lower()
+    return "identity"
+
+
+def _parse_loss(body: dict) -> str:
+    fn = body.get("lossFn")
+    if isinstance(fn, dict) and fn:
+        key = next(iter(fn)).rsplit(".", 1)[-1]
+        loss = _LOSS_FROM_REF.get(key.lower())
+        if loss:
+            return loss
+    legacy = body.get("lossFunction")
+    if isinstance(legacy, str):
+        mapped = _LOSS_LEGACY.get(legacy.lower())
+        if mapped:
+            return mapped
+    return "mcxent"
+
+
+def _layer_from_json(wrapper: dict):
+    (name, body), = wrapper.items()
+    types = _layer_types()
+    if name not in types:
+        raise NotImplementedError(
+            f"reference layer type {name!r} is not supported by the "
+            f"interop reader (supported: {sorted(types)})")
+    kwargs: dict = {
+        "name": body.get("layerName"),
+        "activation": _parse_activation(body),
+        "weight_init": _WEIGHT_INIT_FROM_REF.get(
+            body.get("weightInit", "XAVIER"), "xavier"),
+        "bias_init": float(body.get("biasInit", 0.0) or 0.0),
+        "dropout": float(body.get("dropOut", 0.0) or 0.0),
+        "l1": float(body.get("l1", 0.0) or 0.0),
+        "l2": float(body.get("l2", 0.0) or 0.0),
+    }
+    if name in ("dense", "output", "convolution"):
+        kwargs["n_in"] = int(body.get("nin", 0))
+        kwargs["n_out"] = int(body.get("nout", 0))
+    if name in ("convolution", "subsampling"):
+        for ours, theirs in (("kernel_size", "kernelSize"),
+                             ("stride", "stride"),
+                             ("padding", "padding")):
+            if theirs in body:
+                kwargs[ours] = tuple(body[theirs])
+    if name == "subsampling":
+        kwargs["pooling_type"] = body.get("poolingType", "MAX").lower()
+        kwargs.pop("n_in", None)
+    if name == "output":
+        kwargs["loss"] = _parse_loss(body)
+    return types[name](**kwargs)
+
+
+def read_reference_model(path, load_updater: bool = True):
+    """Restore a MultiLayerNetwork from a REFERENCE-layout zip
+    (``ModelSerializer.restoreMultiLayerNetwork:167``)."""
+    from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
+    from ..nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        top = json.loads(zf.read(CONFIG_JSON).decode("utf-8"))
+        coeff = zf.read(COEFFICIENTS_BIN)
+        updater_blob = (zf.read(UPDATER_BIN)
+                        if load_updater and UPDATER_BIN in zf.namelist()
+                        else None)
+
+    confs = top["confs"]
+    first = confs[0]
+    first_body = next(iter(first["layer"].values()))
+    updater_name = _UPDATER_FROM_REF.get(
+        first_body.get("updater", "SGD"), "sgd")
+    builder = (NeuralNetConfiguration.builder()
+               .seed(int(first.get("seed", 0)))
+               .updater(updater_name)
+               .learning_rate(float(first_body.get("learningRate", 0.1))))
+    lb = builder.list()
+    for conf in confs:
+        lb = lb.layer(_layer_from_json(conf["layer"]))
+    if top.get("backpropType") == "TruncatedBPTT":
+        lb = (lb.backprop_type("tbptt")
+              .t_bptt_forward_length(int(top.get("tbpttFwdLength", 20)))
+              .t_bptt_backward_length(int(top.get("tbpttBackLength", 20))))
+    from ..nn.conf.preprocessors import (CnnToFeedForwardPreProcessor,
+                                         FeedForwardToCnnPreProcessor)
+    for k, wrapper in (top.get("inputPreProcessors") or {}).items():
+        (pname_, body_), = wrapper.items()
+        dims = dict(height=int(body_.get("inputHeight", 0)),
+                    width=int(body_.get("inputWidth", 0)),
+                    channels=int(body_.get("numChannels", 1)))
+        if pname_ == "cnnToFeedForward":
+            lb = lb.input_preprocessor(
+                int(k), CnnToFeedForwardPreProcessor(**dims))
+        elif pname_ == "feedForwardToCnn":
+            lb = lb.input_preprocessor(
+                int(k), FeedForwardToCnnPreProcessor(**dims))
+        else:
+            raise NotImplementedError(
+                f"reference preprocessor {pname_!r} is not supported")
+    mlc = lb.build()
+    net = MultiLayerNetwork(mlc).init()
+    net.iteration = int(top.get("iterationCount", 0))
+    perms = _dense_row_perms(net)
+
+    flat = nd4j_read_array(io.BytesIO(coeff))
+    offset = 0
+    for i, layer in enumerate(net.layers):
+        for pname in layer.param_order():
+            shape = np.asarray(net.params[i][pname]).shape
+            n = int(np.prod(shape))
+            seg = flat[offset:offset + n]
+            if seg.size != n:
+                raise ValueError(
+                    f"coefficients.bin too short at layer {i} param "
+                    f"{pname}: need {n}, have {seg.size}")
+            import jax.numpy as jnp
+            a = _from_ref_layout(layer, pname, seg, shape)
+            if pname == "W" and i in perms:
+                inv = np.empty_like(a)
+                inv[perms[i]] = a        # undo the NHWC->NCHW row perm
+                a = inv
+            net.params[i][pname] = jnp.asarray(a)
+            offset += n
+    if offset != flat.size:
+        raise ValueError(
+            f"coefficients.bin length mismatch: consumed {offset} of "
+            f"{flat.size} values")
+
+    if updater_blob is not None:
+        state_flat = nd4j_read_array(io.BytesIO(updater_blob))
+        offset = 0
+        for i, layer in enumerate(net.layers):
+            uconf = net._updater_conf(i)
+            slots = _UPDATER_SLOTS.get(uconf.updater, ())
+            if not slots:
+                continue
+            for pname in layer.param_order():
+                shape = np.asarray(net.params[i][pname]).shape
+                n = int(np.prod(shape))
+                for slot in slots:
+                    seg = state_flat[offset:offset + n]
+                    if seg.size == n and slot in net.updater_state[i]:
+                        import jax.numpy as jnp
+                        a = _from_ref_layout(layer, pname, seg, shape)
+                        if pname == "W" and i in perms:
+                            inv = np.empty_like(a)
+                            inv[perms[i]] = a
+                            a = inv
+                        net.updater_state[i][slot][pname] = jnp.asarray(a)
+                    offset += n
+    return net
