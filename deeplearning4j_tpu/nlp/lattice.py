@@ -286,6 +286,93 @@ CONNECTION_COSTS: Dict[Tuple[str, str], int] = {
 }
 
 
+def load_dictionary(path: str) -> List[Tuple[str, str, int]]:
+    """Load dictionary entries from a CSV/TSV file (the loadable
+    counterpart of the reference's vendored Kuromoji dictionaries).
+
+    Two line formats are accepted (auto-detected per line, ``#``
+    comments and blank lines skipped; separator is TAB if present,
+    else comma):
+
+    - **simple**: ``surface,pos,cost`` — this module's native triple.
+    - **MeCab-style** (``surface,left_id,right_id,word_cost,POS,...``,
+      the format Kuromoji's dictionary compiler consumes): detected by
+      numeric columns 2-4; the POS tag is taken from column 5 and
+      mapped onto this module's coarse classes via
+      :data:`MECAB_POS_MAP` (unknown tags pass through lowercased).
+    """
+    out: List[Tuple[str, str, int]] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            sep = "\t" if "\t" in line else ","
+            cols = line.split(sep)
+            if len(cols) >= 5 and cols[1].lstrip("-").isdigit() \
+                    and cols[2].lstrip("-").isdigit() \
+                    and cols[3].lstrip("-").isdigit():
+                surface = cols[0]
+                cost = int(cols[3])
+                pos = MECAB_POS_MAP.get(cols[4], cols[4].lower())
+            elif len(cols) == 3:
+                surface, pos = cols[0], cols[1]
+                try:
+                    cost = int(cols[2])
+                except ValueError:
+                    raise ValueError(
+                        f"{path}:{lineno}: cost column is not an int: "
+                        f"{cols[2]!r}")
+            else:
+                raise ValueError(
+                    f"{path}:{lineno}: expected 'surface,pos,cost' or "
+                    f"MeCab-style 'surface,l,r,cost,POS,...'; got "
+                    f"{len(cols)} columns")
+            if not surface:
+                raise ValueError(f"{path}:{lineno}: empty surface")
+            out.append((surface, pos, cost))
+    return out
+
+
+#: MeCab/IPADIC top-level POS tags -> this module's coarse classes.
+MECAB_POS_MAP: Dict[str, str] = {
+    "名詞": "noun", "動詞": "verb", "形容詞": "adj", "副詞": "adv",
+    "助詞": "particle", "助動詞": "aux", "連体詞": "adn",
+    "接続詞": "conj", "感動詞": "interj", "接頭詞": "prefix",
+    "接頭辞": "prefix", "接尾辞": "suffix", "代名詞": "pron",
+    "記号": "punct",
+}
+
+
+def load_connection_matrix(path: str) -> Dict[Tuple[str, str], int]:
+    """Load POS-pair connection costs (the role of Kuromoji's learned
+    ``matrix.def``): one ``left_pos right_pos cost`` triple per line
+    (whitespace- or comma-separated; ``#`` comments skipped).  The
+    virtual classes ``BOS``/``EOS`` are valid on the left/right."""
+    out: Dict[Tuple[str, str], int] = {}
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            cols = line.replace(",", " ").split()
+            if len(cols) != 3:
+                raise ValueError(
+                    f"{path}:{lineno}: expected 'left right cost', got "
+                    f"{line!r}")
+            out[(cols[0], cols[1])] = int(cols[2])
+    return out
+
+
+def save_dictionary(entries: Sequence[Tuple[str, str, int]],
+                    path: str, sep: str = ",") -> None:
+    """Write entries in the simple ``surface,pos,cost`` format
+    :func:`load_dictionary` reads (round-trip tested)."""
+    with open(path, "w", encoding="utf-8") as fh:
+        for surface, pos, cost in entries:
+            fh.write(f"{surface}{sep}{pos}{sep}{cost}\n")
+
+
 class Trie:
     """Character trie with common-prefix search (DoubleArrayTrie role)."""
 
@@ -354,6 +441,28 @@ class LatticeTokenizer:
         self.trie = Trie(self.entries)
         self.conn = dict(CONNECTION_COSTS if connection_costs is None
                          else connection_costs)
+
+    @classmethod
+    def from_files(cls, dictionary_path: str,
+                   connection_path: Optional[str] = None,
+                   include_bundled: bool = True) -> "LatticeTokenizer":
+        """Build a tokenizer from on-disk dictionary assets — the
+        loadable-dictionary tier (the reference vendors Kuromoji's
+        compiled dictionaries + learned connection matrix,
+        ``deeplearning4j-nlp-japanese``; here the assets are plain
+        text, see :func:`load_dictionary` /
+        :func:`load_connection_matrix` for the formats).
+
+        ``include_bundled=True`` layers the file's entries OVER the
+        bundled 440-entry dictionary (user-dictionary semantics —
+        Kuromoji's ``UserDictionary`` augments the system dictionary);
+        ``False`` uses the file alone."""
+        entries = list(DICTIONARY) if include_bundled else []
+        entries.extend(load_dictionary(dictionary_path))
+        conn = dict(CONNECTION_COSTS) if include_bundled else {}
+        if connection_path is not None:
+            conn.update(load_connection_matrix(connection_path))
+        return cls(entries, conn)
 
     # ---------------------------------------------------------------- core
     def _conn(self, left: str, right: str) -> int:
