@@ -1,9 +1,9 @@
 """The examples gallery must stay runnable (the dl4j-examples role —
-user-facing entry points are product surface, not documentation).  The
-fast CPU examples run here; the heavier ones (lenet_mnist, char_lstm,
-ui_dashboard — minutes of training — and native_inference, which needs a
-PJRT plugin) are exercised by their subsystem suites instead
-(test_nativeops, test_recurrent, test_ui)."""
+user-facing entry points are product surface, not documentation).
+EVERY example executes end-to-end here: the fast ones at their default
+sizes, the heavy ones (lenet_mnist, char_lstm, ui_dashboard,
+native_inference) as tiny real runs — 1-2 steps on small shapes — so
+example rot cannot hide behind a compile-only check."""
 
 import os
 import runpy
@@ -44,11 +44,30 @@ def test_word2vec_example():
     assert w2v.has_word("king")
 
 
-@pytest.mark.parametrize("name", ["lenet_mnist.py", "char_lstm.py",
-                                  "ui_dashboard.py",
-                                  "native_inference.py"])
-def test_heavy_examples_at_least_compile(name):
-    """The heavy scripts don't train in CI, but they must stay
-    syntactically valid and importable-shaped (bit-rot guard)."""
-    import py_compile
-    py_compile.compile(os.path.join(EXAMPLES, name), doraise=True)
+def test_lenet_mnist_example_executes():
+    """Tiny real run (2 batches x 1 epoch) — every example executes
+    end-to-end in CI, not just compiles (example-rot guard, reference
+    example-driven test style in deeplearning4j-core/src/test)."""
+    mod = _run("lenet_mnist.py")
+    acc = mod["main"](num_examples=256, epochs=1)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_char_lstm_example_executes():
+    mod = _run("char_lstm.py")
+    score = mod["main"](epochs=1, hidden=16, seq=16)
+    assert float(score) > 0.0          # cross-entropy on a real sample
+
+
+def test_ui_dashboard_example_executes():
+    mod = _run("ui_dashboard.py")
+    mod["main"](iterations=5, serve_forever=False)
+
+
+def test_native_inference_example_executes():
+    """Runs the native PJRT serve path when the plugin is present; the
+    example returns None (and says why) when it is not — either way the
+    script executes end to end."""
+    mod = _run("native_inference.py")
+    result = mod["main"]()
+    assert result in (True, None)   # None = no PJRT plugin (said why)
