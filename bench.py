@@ -42,10 +42,53 @@ def _bf16_if_tpu():
                              for d in jax.devices()) else None
 
 
-def _best_of(fn, trials: int) -> float:
-    """Run ``fn`` (returns elapsed seconds) ``trials`` times, return the
-    minimum elapsed."""
-    return min(fn() for _ in range(trials))
+def _measured(fn, trials: int) -> dict:
+    """Run ``fn`` (returns elapsed seconds) ``trials`` times and return
+    the median elapsed plus a variance band.  The tunnel's host<->device
+    round-trip fluctuates ~1-90 ms by hour (BASELINE.md), so a single
+    best-of number can mistake tunnel weather for a perf change; the
+    median over timed windows plus the min/max spread makes cross-round
+    comparisons falsifiable (round-4 verdict, weak item 3)."""
+    times = sorted(fn() for _ in range(trials))
+    n = len(times)
+    median = (times[n // 2] if n % 2 else
+              0.5 * (times[n // 2 - 1] + times[n // 2]))
+    return {"median": median, "best": times[0], "worst": times[-1]}
+
+
+def _band_fields(meas: dict, scale: float, trials: int) -> dict:
+    """Per-window rates derived from a ``_measured`` result: best/worst
+    rates and the spread as a fraction of the median-rate value."""
+    val = scale / meas["median"]
+    out = {"best": round(scale / meas["best"], 1),
+           "worst": round(scale / meas["worst"], 1),
+           "trials": trials}
+    if val:
+        out["spread_pct"] = round(
+            100.0 * (out["best"] - out["worst"]) / val, 1)
+    return out
+
+
+def tunnel_probe(k: int = 12) -> dict:
+    """Host<->device round-trip latency over the tunnel: k tiny
+    transfer+fetch round trips, median/min/max in ms.  Printed alongside
+    the bench lines so a reader can tell tunnel weather from chip
+    regressions (round-4 verdict, weak item 3)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.zeros((8,), jnp.float32)
+    float(np.asarray(x + 1.0)[0])        # warm the compile + connection
+
+    def one_rtt() -> float:
+        t0 = time.perf_counter()
+        float(np.asarray(x + 1.0)[0])
+        return time.perf_counter() - t0
+
+    meas = _measured(one_rtt, k)
+    return {"metric": "tunnel_rtt_ms", "value": round(meas["median"] * 1e3, 2),
+            "unit": "ms", "min": round(meas["best"] * 1e3, 2),
+            "max": round(meas["worst"] * 1e3, 2), "k": k,
+            "vs_baseline": None}
 
 
 # Chip peaks for the roofline/MFU report (bf16 matmul peak, HBM stream
@@ -156,10 +199,10 @@ def _run_scan_bench(net, feats, labels, steps: int, pipeline: int,
         float(np.asarray(scores)[-1])
         return time.perf_counter() - t0
 
-    elapsed = _best_of(timed, trials)
+    meas = _measured(timed, trials)
     net.params, net.updater_state = state["p"], state["u"]
     net.net_state, net.iteration = state["s"], state["it"]
-    return elapsed, cost
+    return meas, cost
 
 
 def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
@@ -204,9 +247,10 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
     # device->host completion fetch (the only reliable barrier over the
     # tunneled TPU) — so the tunnel's round-trip latency (observed
     # 1-90 ms by hour) amortizes over pipeline*steps on-chip steps.
-    elapsed, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
-                                    trials)
-    sps = pipeline * steps * batch / elapsed
+    meas, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
+                                 trials)
+    work = pipeline * steps * batch
+    sps = work / meas["median"]
     result = {
         "metric": "lenet_mnist_train_samples_per_sec_per_chip",
         "value": round(sps, 1),
@@ -214,7 +258,8 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
         "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
         "batch": batch,
     }
-    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     return result
 
 
@@ -247,13 +292,15 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
 
-    elapsed, cost = _run_scan_bench(net, [f_stk], [l_stk], steps,
-                                    pipeline, trials)
-    sps = pipeline * steps * batch / elapsed
+    meas, cost = _run_scan_bench(net, [f_stk], [l_stk], steps,
+                                 pipeline, trials)
+    work = pipeline * steps * batch
+    sps = work / meas["median"]
     result = {"metric": "resnet50_imagenet_train_samples_per_sec_per_chip",
               "value": round(sps, 1), "unit": "samples/sec/chip",
               "vs_baseline": None, "batch": batch}
-    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     return result
 
 
@@ -295,13 +342,15 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
 
-    elapsed, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
-                                    trials)
-    chars = pipeline * steps * batch * seq / elapsed
+    meas, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
+                                 trials)
+    work = pipeline * steps * batch * seq
+    chars = work / meas["median"]
     result = {"metric": "graves_lstm_charnn_chars_per_sec_per_chip",
               "value": round(chars, 1), "unit": "chars/sec/chip",
               "vs_baseline": None, "batch": batch, "seq": seq}
-    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     return result
 
 
@@ -331,13 +380,15 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
 
-    elapsed, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
-                                    trials)
-    sps = pipeline * steps * batch / elapsed
+    meas, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
+                                 trials)
+    work = pipeline * steps * batch
+    sps = work / meas["median"]
     result = {"metric": "vgg16_import_train_samples_per_sec_per_chip",
               "value": round(sps, 1), "unit": "samples/sec/chip",
               "vs_baseline": None, "batch": batch}
-    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     return result
 
 
@@ -383,8 +434,24 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
         float(np.asarray(losses)[-1])   # fetch = completion barrier
         return s0, s1
 
-    # roofline from a 1-step twin (see _run_scan_bench)
+    # FLOPs from XLA's 1-step twin; HBM bytes from a HAND model — the XLA
+    # cost model charges every scatter/gather full-table traffic
+    # (V x D x 4 bytes each), reporting ~41 GB/step for a kernel that
+    # touches ~100 k rows, so its HBM fraction exceeded 1.0 and the row
+    # was unfalsifiable (round-4 verdict, weak item 4).  Real traffic per
+    # step: syn0 rows read+written once per pair row (2 x B x D x 4) plus
+    # syn1neg rows read+written once per (positive|negative) target
+    # (2 x B x (1+K) x D x 4), plus the int32 index/label operands;
+    # rows hit k times in one batch still stream ~once thanks to cache
+    # locality, so this is the achievable-traffic model, not a lower
+    # bound artifact.
     cost = _compiled_cost(multi.lower(syn0, syn1, 1).compile())
+    K = negative
+    hand_bytes = (2 * batch * dim * 4            # syn0 gather + scatter
+                  + 2 * batch * (1 + K) * dim * 4  # syn1neg gather+scatter
+                  + batch * 4                    # inputs (int32)
+                  + batch * (1 + K) * (4 + 4 + 4))  # targets+tmask+labels
+    cost["bytes"] = float(hand_bytes)
     syn0, syn1 = run_once(syn0, syn1)
 
     def timed() -> float:
@@ -393,12 +460,15 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
         syn0, syn1 = run_once(syn0, syn1)
         return time.perf_counter() - t0
 
-    elapsed = _best_of(timed, trials)
-    pairs = pipeline * steps * batch / elapsed
+    meas = _measured(timed, trials)
+    work = pipeline * steps * batch
+    pairs = work / meas["median"]
     result = {"metric": "word2vec_sgns_pairs_per_sec_per_chip",
               "value": round(pairs, 1), "unit": "pairs/sec/chip",
-              "vs_baseline": None, "batch": batch}
-    result.update(_roofline_fields(cost, pipeline * steps / elapsed))
+              "vs_baseline": None, "batch": batch,
+              "hbm_model": "hand (see bench_word2vec)"}
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     return result
 
 
@@ -433,11 +503,14 @@ def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
         float(loss)
         return time.perf_counter() - t0
 
-    elapsed = _best_of(timed, trials)
-    tokens = steps * batch * seq / elapsed
-    return {"metric": "flash_attention_train_tokens_per_sec_per_chip",
-            "value": round(tokens, 1), "unit": "tokens/sec/chip",
-            "vs_baseline": None, "batch": batch, "seq": seq}
+    meas = _measured(timed, trials)
+    work = steps * batch * seq
+    tokens = work / meas["median"]
+    result = {"metric": "flash_attention_train_tokens_per_sec_per_chip",
+              "value": round(tokens, 1), "unit": "tokens/sec/chip",
+              "vs_baseline": None, "batch": batch, "seq": seq}
+    result.update(_band_fields(meas, work, trials))
+    return result
 
 
 def bench_native_ingest(batch: int = 256, steps: int = 50,
@@ -469,13 +542,54 @@ def bench_native_ingest(batch: int = 256, steps: int = 50,
         epoch()
         return time.perf_counter() - t0
 
-    elapsed = _best_of(timed, trials)
+    meas = _measured(timed, trials)
     it.close()
-    sps = steps * batch / elapsed
-    return {"metric": "native_ring_to_fit_scan_samples_per_sec",
-            "value": round(sps, 1), "unit": "samples/sec/chip",
-            "vs_baseline": None, "batch": batch,
-            "native_prefetcher": bool(native)}
+    work = steps * batch
+    sps = work / meas["median"]
+    result = {"metric": "native_ring_to_fit_scan_samples_per_sec",
+              "value": round(sps, 1), "unit": "samples/sec/chip",
+              "vs_baseline": None, "batch": batch,
+              "native_prefetcher": bool(native)}
+    result.update(_band_fields(meas, work, trials))
+    return result
+
+
+def bench_fit_iterator(batch: int = 256, examples: int = 60000,
+                       epochs_per_window: int = 2,
+                       trials: int = 3) -> list:
+    """End-to-end ``MultiLayerNetwork.fit(iterator)`` through the product
+    API — the path a real user pays for (round-4 verdict item 1: the
+    overlapped-ingest rework must post a BENCH number vs the 1.47M
+    staged ceiling).  Two lines: the device-resident epoch-cache path
+    (MNIST fits HBM; per-epoch host traffic is one int32 permutation)
+    and the windowed double-buffered staging path (forced, as if the
+    dataset didn't fit), both on the full 60k-example MNIST epoch."""
+    from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    results = []
+    for mode in ("cache", "window"):
+        net = MultiLayerNetwork(lenet(compute_dtype=_bf16_if_tpu())).init()
+        it = MnistDataSetIterator(batch, examples)
+        net.fit(it, epochs=1, ingest=mode)   # warmup: compile + first epoch
+
+        def timed() -> float:
+            t0 = time.perf_counter()
+            net.fit(it, epochs=epochs_per_window, ingest=mode)
+            net.score()    # device->host fetch = the completion barrier
+            return time.perf_counter() - t0
+
+        meas = _measured(timed, trials)
+        work = epochs_per_window * examples
+        sps = work / meas["median"]
+        result = {"metric": f"fit_iterator_{mode}_samples_per_sec",
+                  "value": round(sps, 1), "unit": "samples/sec/chip",
+                  "vs_baseline": None, "batch": batch,
+                  "examples_per_epoch": examples}
+        result.update(_band_fields(meas, work, trials))
+        results.append(result)
+    return results
 
 
 def bench_scaling() -> dict:
@@ -514,14 +628,22 @@ def bench_scaling() -> dict:
 
 def main() -> None:
     run_all = "--all" in sys.argv
+    try:
+        print(json.dumps(tunnel_probe()), file=sys.stderr, flush=True)
+    except Exception as e:
+        print(json.dumps({"metric": "tunnel_rtt_ms", "error": repr(e)}),
+              file=sys.stderr, flush=True)
     result = bench_lenet()
     print(json.dumps(result), flush=True)
     if not run_all:
         return
     for fn in (bench_resnet50, bench_vgg16, bench_lstm, bench_word2vec,
-               bench_flash_attention, bench_native_ingest, bench_scaling):
+               bench_flash_attention, bench_fit_iterator,
+               bench_native_ingest, bench_scaling):
         try:
-            print(json.dumps(fn()), file=sys.stderr, flush=True)
+            out = fn()
+            for line in (out if isinstance(out, list) else [out]):
+                print(json.dumps(line), file=sys.stderr, flush=True)
         except Exception as e:  # keep going: one config failing is data too
             print(json.dumps({"metric": fn.__name__, "error": repr(e)}),
                   file=sys.stderr, flush=True)

@@ -343,8 +343,8 @@ class ComputationGraph:
         epoch is one gather-scan dispatch per batch-shape."""
         from . import ingest
 
-        data_fs = (jnp.asarray(np.asarray(source._ds.features)),)
-        data_ls = (jnp.asarray(np.asarray(source._ds.labels)),)
+        dev_f, dev_l = ingest.device_cached_arrays(self, source._ds)
+        data_fs, data_ls = (dev_f,), (dev_l,)
         replay = ingest.ScoreReplayer(self)
         for _ in range(epochs):
             for listener in self.listeners:
@@ -379,6 +379,8 @@ class ComputationGraph:
 
         def dispatch(buf):
             features, labels, fms, lms = ingest.stack_multi_window(buf)
+            cdt = self.conf.conf.compute_dtype
+            features = [ingest.cast_for_transfer(f, cdt) for f in features]
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,

@@ -82,6 +82,28 @@ def cacheable_source(iterator):
     return u
 
 
+def device_cached_arrays(model, ds) -> Tuple:
+    """Device copies of ``ds.features``/``ds.labels`` that stay resident
+    ACROSS ``fit()`` calls (true epoch-cache residency: without this,
+    every fit() re-paid the full dataset host->device transfer — 188 MB
+    for f32 MNIST — which dominated end-to-end throughput over the
+    tunnel).  The cache lives on the model and is keyed by host-array
+    identity: it holds references to the exact feature/label ndarrays it
+    uploaded, so re-use requires ``ds`` to still expose those same
+    objects; assigning new arrays re-uploads.  In-place mutation of the
+    same arrays between fits is NOT detected — matching the reference's
+    posture that a dataset is immutable while training on it."""
+    import jax.numpy as jnp
+    f = np.asarray(ds.features)
+    l = np.asarray(ds.labels)
+    cache = getattr(model, "_ingest_device_cache", None)
+    if cache is not None and cache[0] is f and cache[1] is l:
+        return cache[2], cache[3]
+    dev_f, dev_l = jnp.asarray(f), jnp.asarray(l)
+    model._ingest_device_cache = (f, l, dev_f, dev_l)
+    return dev_f, dev_l
+
+
 def epoch_order(u) -> np.ndarray:
     """Advance ``u`` through one epoch's worth of state transitions and
     return the example order that epoch would have used.
@@ -171,6 +193,21 @@ def stack_multi_window(mbs) -> Tuple:
     fmasks = masks(lambda m: m.features_masks, n_in)
     lmasks = masks(lambda m: m.labels_masks, n_out)
     return features, labels, fmasks, lmasks
+
+
+def cast_for_transfer(features: np.ndarray, compute_dtype) -> np.ndarray:
+    """Halve the windowed path's host->device bytes: when the model
+    computes in bfloat16, cast float32 feature stacks on HOST before the
+    transfer.  The train step's first action on floating inputs is this
+    exact cast (``multilayer.py`` ``_forward``: inputs go to the compute
+    dtype), both sides round-to-nearest-even, so this just moves the
+    cast across the wire — identical numerics, half the bytes on the
+    bandwidth-bound link.  Integer features (embedding ids) and labels
+    (loss-side) are left untouched."""
+    if compute_dtype != "bfloat16" or features.dtype != np.float32:
+        return features
+    import ml_dtypes
+    return features.astype(ml_dtypes.bfloat16)
 
 
 class ScoreReplayer:

@@ -319,8 +319,7 @@ class MultiLayerNetwork:
         replaying the scanned scores."""
         from . import ingest
 
-        data_f = jnp.asarray(np.asarray(source._ds.features))
-        data_l = jnp.asarray(np.asarray(source._ds.labels))
+        data_f, data_l = ingest.device_cached_arrays(self, source._ds)
         replay = ingest.ScoreReplayer(self)
         for _ in range(epochs):
             for listener in self.listeners:
@@ -358,6 +357,8 @@ class MultiLayerNetwork:
 
         def dispatch(buf):
             features, labels, fm, lm = ingest.stack_window(buf)
+            features = ingest.cast_for_transfer(
+                features, self.conf.conf.compute_dtype)
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,

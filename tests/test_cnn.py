@@ -286,3 +286,43 @@ def test_pointwise_conv_matches_general_conv():
             x, k, (1, 1), "SAME" if mode == "same" else [(0, 0), (0, 0)],
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(ref))
+
+
+def test_bf16_conv_training_on_cpu_tier():
+    """bf16 mixed-precision TRAINING must work on the CPU fallback tier:
+    the f32-accumulation path used preferred_element_type=f32 over bf16
+    operands, whose conv transpose emits a mixed-dtype conv that lax
+    rejects — so any differentiated conv (every fit) raised TypeError.
+    Regression: train a conv net under compute_dtype=bfloat16 and check
+    the score is finite and decreasing."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import inputs
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.RandomState(7)
+    f = rng.rand(16, 6, 6, 2).astype(np.float32)
+    l = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater("sgd").learning_rate(0.05)
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(1, 1),
+                                    activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(inputs.convolutional(6, 6, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(f, l)
+    net.fit(ds)
+    first = net.score()
+    assert np.isfinite(first)
+    for _ in range(20):
+        net.fit(ds)
+    assert np.isfinite(net.score())
+    assert net.score() < first
