@@ -472,6 +472,47 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
     return result
 
 
+def bench_word2vec_fit(vocab: int = 10000, dim: int = 128,
+                       corpus_words: int = 2_000_000, sent_len: int = 1000,
+                       negative: int = 5, batch: int = 8192,
+                       trials: int = 3) -> dict:
+    """END-TO-END ``SequenceVectors.fit()`` pairs/s through the
+    on-device pair-generation pipeline (``nlp/device_corpus.py``):
+    subsampling, window draws, and unigram negative draws all on-chip,
+    one scan dispatch per corpus pass.  The round-4 host feeding loop
+    bounded this path orders of magnitude below the 11.8M pairs/s
+    staged kernel rate (round-4 verdict item 4); the target is within
+    ~2x of staged.  Vocab build (host, one-time) is excluded — the
+    metric is the training loop, matching the staged bench's scope."""
+    from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+    rng = np.random.RandomState(0)
+    n_sent = corpus_words // sent_len
+    seqs = [["w%d" % w for w in rng.randint(0, vocab, sent_len)]
+            for _ in range(n_sent)]
+    sv = SequenceVectors(layer_size=dim, window_size=5, negative=negative,
+                         use_hierarchic_softmax=False, batch_size=batch,
+                         epochs=1, min_word_frequency=1,
+                         pair_generation="device")
+    sv.build_vocab(seqs)
+    sv.fit(seqs)        # warmup: corpus upload + compile + one pass
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        sv.fit(seqs)    # finish() fetches counters = completion barrier
+        return time.perf_counter() - t0
+
+    meas = _measured(timed, trials)
+    pairs = sv._device_pipeline_stats["pairs_trained"]
+    rate = pairs / meas["median"]
+    result = {"metric": "word2vec_fit_end_to_end_pairs_per_sec",
+              "value": round(rate, 1), "unit": "pairs/sec/chip",
+              "vs_baseline": None, "corpus_words": corpus_words,
+              "pairs_per_pass": round(pairs, 0)}
+    result.update(_band_fields(meas, pairs, trials))
+    return result
+
+
 def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
                           d_head: int = 64, steps: int = 8,
                           trials: int = 3) -> dict:
@@ -638,8 +679,8 @@ def main() -> None:
     if not run_all:
         return
     for fn in (bench_resnet50, bench_vgg16, bench_lstm, bench_word2vec,
-               bench_flash_attention, bench_fit_iterator,
-               bench_native_ingest, bench_scaling):
+               bench_word2vec_fit, bench_flash_attention,
+               bench_fit_iterator, bench_native_ingest, bench_scaling):
         try:
             out = fn()
             for line in (out if isinstance(out, list) else [out]):

@@ -1,0 +1,399 @@
+"""On-device skip-gram pair generation: the whole word2vec inner loop
+as one ``lax.scan`` dispatch per corpus pass.
+
+The round-4 end-to-end ``Word2Vec.fit()`` was bound by HOST pair
+generation — ``SequenceVectors._generate_pairs`` is a Python
+per-position loop, and negative draws were host numpy — so the real fit
+ran orders of magnitude below the 11.8M pairs/s staged kernel rate.
+This module moves the reference's feeding loop (the per-thread Java
+loop around ``models/embeddings/learning/impl/elements/SkipGram.java:258``
+that feeds the native ``AggregateSkipGram`` op) onto the chip:
+
+- the tokenized corpus is uploaded ONCE as a flat int32 index array plus
+  a sentence-id array (windows never cross sentence boundaries);
+- frequent-word subsampling happens on device per pass (uniform draw
+  against a per-word keep probability, then a cumsum/scatter compaction
+  so windows close up over removed words — word2vec.c semantics);
+- each scan step takes a chunk of positions, draws the per-center
+  window shrink b ~ U[0, W) on device, forms the (B, 2W) context grid
+  with offset/boundary/sentence masks, draws negatives from the
+  device-resident unigram table, and applies the same HS/NS update math
+  as the host path (shared ``_hs_update`` / ``_ns_update``);
+- per-chunk learning rates follow the linear word-count decay schedule
+  and are precomputed host-side as scan inputs.
+
+Per-pass host traffic: one scalar fetch (the pair/loss counters used as
+the completion barrier).  Semantics vs the host path: identical update
+math and masking; the RNG stream differs (device threefry vs host
+MT19937), per-chunk lr replaces per-sequence lr (the same compromise
+the host path's cross-sequence batching already makes), and
+``iterations`` repeats the whole corpus pass rather than each sequence
+in place (alpha decays by words seen either way).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .word2vec import _hs_update, _ns_update
+
+Array = jax.Array
+
+
+def build_corpus_arrays(seqs: List[np.ndarray],
+                        chunk: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Flatten per-sequence index arrays into (corpus, sent_id) padded to
+    a multiple of ``chunk``.  Padding positions get sent_id -1 so the
+    same-sentence mask kills any pair touching them."""
+    n = sum(s.size for s in seqs)
+    npad = max(chunk, ((n + chunk - 1) // chunk) * chunk)
+    corpus = np.zeros(npad, np.int32)
+    sent = np.full(npad, -1, np.int32)
+    pos = 0
+    for i, s in enumerate(seqs):
+        corpus[pos:pos + s.size] = s
+        sent[pos:pos + s.size] = i
+        pos += s.size
+    return corpus, sent, n
+
+
+def keep_probabilities(vocab, sampling: float) -> Optional[np.ndarray]:
+    """Per-word subsampling keep probability (word2vec: keep prob
+    min(1, sqrt(t/f') + t/f') with f' = freq/(sample*total)); None when
+    subsampling is off."""
+    if sampling <= 0:
+        return None
+    words = vocab.vocab_words()
+    keep = np.ones(len(words), np.float32)
+    total = vocab.total_word_count
+    for w in words:
+        ratio = sampling * total / max(w.element_frequency, 1.0)
+        keep[w.index] = min(1.0, np.sqrt(ratio) + ratio)
+    return keep
+
+
+def window_offsets(window: int) -> np.ndarray:
+    """The +-window offset row of the pair grid (0 excluded)."""
+    return np.concatenate(
+        [np.arange(-window, 0), np.arange(1, window + 1)]).astype(np.int32)
+
+
+def pair_grid(corpus: Array, sent: Array, n_valid, start, shrink: Array,
+              window: int, chunk: int):
+    """(inputs, targets, pair_mask) for one chunk of center positions.
+
+    For center position p with per-center window win = W - shrink[p]
+    (word2vec's dynamic shrink), the grid row covers offsets
+    [-W..-1, 1..W]; a cell is live iff |offset| <= win, both positions
+    are in [0, n_valid), and the neighbor is in the same sentence.
+    Shapes are static (chunk x 2W flattened) so the scan body compiles
+    once.  Testable standalone against a brute-force host reference."""
+    npad = corpus.shape[0]
+    offsets = jnp.asarray(window_offsets(window))
+    pos = start + jnp.arange(chunk)
+    centers = jax.lax.dynamic_slice(corpus, (start,), (chunk,))
+    csent = jax.lax.dynamic_slice(sent, (start,), (chunk,))
+    win = window - shrink                           # in [1..W]
+    nbr = pos[:, None] + offsets[None, :]           # (B, 2W)
+    inb = (nbr >= 0) & (nbr < n_valid) & (pos < n_valid)[:, None]
+    nbr_c = jnp.clip(nbr, 0, npad - 1)
+    words = corpus[nbr_c]
+    wsent = sent[nbr_c]
+    pmask = (inb & (wsent == csent[:, None])
+             & (jnp.abs(offsets)[None, :] <= win[:, None]))
+    P = chunk * 2 * window
+    inputs = words.reshape(P)                       # context = syn0 row
+    targets = jnp.broadcast_to(
+        centers[:, None], (chunk, 2 * window)).reshape(P)
+    return inputs, targets, pmask.reshape(P).astype(jnp.float32)
+
+
+def pair_grid_shaped(corpus_pad: Array, sent_pad: Array, start,
+                     shrink: Array, window: int, span: int):
+    """Gather-free pair grid over W-padded arrays (sentinel sent_id -1
+    at both ends), kept in (span, 2W) grid shape: one dynamic_slice
+    pulls the span's (span + 2W) region, then each window offset is a
+    STATIC shifted slice of that region.  The random-gather formulation
+    profiled at ~2 ms/span (65k scalar gathers); shifts are pure vector
+    moves.  Center position p of the span maps to padded index
+    start + W + p.  The sentinel handles every boundary: corpus ends,
+    sentence ends, subsampling's compacted tail — a cell is live iff
+    the center's sentence id is >= 0, the neighbor's matches it, and
+    the offset is within the shrunk window.  Returns
+    (words (span, 2W), centers (span,), pmask (span, 2W) f32)."""
+    offsets = window_offsets(window)
+    region_c = jax.lax.dynamic_slice(corpus_pad, (start,),
+                                     (span + 2 * window,))
+    region_s = jax.lax.dynamic_slice(sent_pad, (start,),
+                                     (span + 2 * window,))
+    centers = jax.lax.slice(region_c, (window,), (window + span,))
+    csent = jax.lax.slice(region_s, (window,), (window + span,))
+    words = jnp.stack(
+        [jax.lax.slice(region_c, (window + int(o),),
+                       (window + int(o) + span,)) for o in offsets],
+        axis=1)                                       # (span, 2W)
+    wsent = jnp.stack(
+        [jax.lax.slice(region_s, (window + int(o),),
+                       (window + int(o) + span,)) for o in offsets],
+        axis=1)
+    win = window - shrink
+    pmask = ((csent >= 0)[:, None] & (wsent == csent[:, None])
+             & (jnp.abs(jnp.asarray(offsets))[None, :] <= win[:, None]))
+    return words, centers, pmask.astype(jnp.float32)
+
+
+def pair_grid_shifted(corpus_pad: Array, sent_pad: Array, start,
+                      shrink: Array, window: int, span: int):
+    """Flattened view of :func:`pair_grid_shaped` matching
+    :func:`pair_grid`'s (inputs, targets, pair_mask) contract —
+    equivalence with the gather-based reference grid is test-asserted."""
+    words, centers, pmask = pair_grid_shaped(
+        corpus_pad, sent_pad, start, shrink, window, span)
+    P = span * 2 * window
+    inputs = words.reshape(P)
+    targets = jnp.broadcast_to(
+        centers[:, None], (span, 2 * window)).reshape(P)
+    return inputs, targets, pmask.reshape(P)
+
+
+def pad_with_sentinels(corpus: Array, sent: Array, window: int):
+    """W sentinel entries (word 0, sent_id -1) on each side, for
+    :func:`pair_grid_shifted`."""
+    zc = jnp.zeros((window,), corpus.dtype)
+    zs = jnp.full((window,), -1, sent.dtype)
+    return (jnp.concatenate([zc, corpus, zc]),
+            jnp.concatenate([zs, sent, zs]))
+
+
+def subsample_compact(corpus: Array, sent: Array, keep: Array):
+    """Compact (corpus, sent) down to the kept positions (windows close
+    up over removed words — word2vec.c subsampling semantics); dropped
+    tail gets sentinel sent_id -1.  Returns (corpus, sent, n_valid)."""
+    npad = corpus.shape[0]
+    tgt = jnp.cumsum(keep) - 1
+    dest = jnp.where(keep, tgt, npad)
+    corpus = jnp.zeros_like(corpus).at[dest].set(corpus, mode="drop")
+    sent = jnp.full_like(sent, -1).at[dest].set(sent, mode="drop")
+    return corpus, sent, jnp.sum(keep)
+
+
+def block_negative_table(table: np.ndarray, k: int,
+                         seed: int) -> np.ndarray:
+    """Shuffle the word2vec unigram table ONCE on host and fold it into
+    (table_size // k, k) blocks.  The raw table is built in long
+    per-word runs, so un-shuffled blocks would hold k copies of one
+    word; after shuffling, every block is k draws-without-replacement
+    from the unigram^0.75 multiset — statistically equivalent to
+    word2vec's with-replacement draws for table_size >> k."""
+    table = np.random.RandomState(seed).permutation(table)
+    n = (table.size // k) * k
+    return table[:n].reshape(-1, k).astype(np.int32)
+
+
+def lcg_negatives(seed: Array, rows: int, k: int, table_2d: Array):
+    """(rows, k) negative draws: one 32-bit LCG draw per row (the
+    word2vec.c sampler family — ``next_random = next_random *
+    25214903917 + 11`` there; Numerical-Recipes constants here, 32-bit
+    for the TPU's native integer width) selecting one ROW of the
+    pre-shuffled block table (:func:`block_negative_table`).
+
+    Why this shape: per-element table gathers profiled at ~7 µs per 1k
+    elements on this chip (123k gathers/span = 0.86 ms — comparable to
+    the update kernel itself), threefry per-step draws cost ~0.3 ms
+    more, and a vmap(dynamic_slice) contiguous-window formulation
+    lowered to a 29 ms/span catastrophe.  Row gathers of a 2-D table
+    are the embedding-lookup pattern the TPU does well.  Residual
+    collisions with the positive are masked by the caller, exactly as
+    word2vec skips target==positive draws."""
+    state = (seed + jnp.arange(rows, dtype=jnp.uint32)
+             * jnp.uint32(2654435761))           # Knuth hash spread
+    state = state * jnp.uint32(1664525) + jnp.uint32(1013904223)
+    n_blocks = table_2d.shape[0]
+    base = ((state >> jnp.uint32(4))
+            % jnp.uint32(n_blocks)).astype(jnp.int32)
+    return table_2d[base]
+
+
+@functools.lru_cache(maxsize=8)
+def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
+              n_spans: int, subsample: bool, npad: int):
+    """Build + jit the one-pass scan.  All shape-determining config is
+    in the cache key; arrays are traced arguments.
+
+    Structure per scan step (one SPAN of ``span`` center positions):
+    draw window shrinks -> (span, 2W) pair grid -> ONE center-aggregated
+    fused update.  The aggregation is the load-bearing trick: a center's
+    2W grid cells all train the SAME target rows (its Huffman path / its
+    negative draws), so their syn1-side contributions are summed with an
+    einsum over the cell axis BEFORE the scatter — span*(1+K) (or
+    span*L) scatter rows instead of span*2W*(1+K).  Scatter rows, not
+    FLOPs, are what the TPU pays for here (profiled ~7M scatter
+    rows/s vs ~100 MFLOP of einsum ≈ nothing), so this is ~2W x less
+    scatter on the syn1 side; dead grid cells cost only MXU flops.
+    Divergences from the per-pair host kernels, both documented and
+    quality-tested: negatives are drawn per CENTER (shared by its <=2W
+    pairs) rather than per pair — same expected gradient, slightly
+    correlated draws within one center; and each center's cells see the
+    center's syn1 rows at the span's start value (the same
+    stale-read-within-batch compromise every batched scatter update in
+    this module already makes)."""
+    K = negative
+
+    def epoch(syn0, syn1, syn1neg, corpus, sent, n_words, keep_prob,
+              neg_table, hs_points, hs_codes, hs_cmask, alphas, key):
+        if subsample:
+            key, sub = jax.random.split(key)
+            r = jax.random.uniform(sub, corpus.shape)
+            live = jnp.arange(npad) < n_words
+            keep = (r < keep_prob[corpus]) & live
+            corpus, sent, _ = subsample_compact(corpus, sent, keep)
+        corpus_pad, sent_pad = pad_with_sentinels(corpus, sent, window)
+        span_keys = jax.random.split(key, n_spans)
+
+        def body(carry, xs):
+            syn0, syn1, syn1neg, pair_count, loss_sum = carry
+            c, alpha, ckey = xs
+            kb, kn = jax.random.split(ckey)
+            shrink = jax.random.randint(kb, (span,), 0, window)
+            words, centers, pmask = pair_grid_shaped(
+                corpus_pad, sent_pad, c * span, shrink, window, span)
+            h = syn0[words]                        # (b, 2W, d)
+            loss = jnp.float32(0.0)
+            d_syn0 = None                          # (b, 2W, d) cotangent
+            if use_hs:
+                pts = hs_points[centers]           # (b, L)
+                cds = hs_codes[centers]
+                cmk = hs_cmask[centers]
+                w = syn1[pts]                      # (b, L, d)
+                logits = jnp.einsum("bcd,bld->bcl", h, w)
+                g = ((1.0 - cds[:, None, :] - jax.nn.sigmoid(logits))
+                     * cmk[:, None, :] * pmask[:, :, None] * alpha)
+                syn1 = syn1.at[pts].add(
+                    jnp.einsum("bcl,bcd->bld", g, h))
+                d_syn0 = jnp.einsum("bcl,bld->bcd", g, w)
+                loss = loss - jnp.sum(
+                    jax.nn.log_sigmoid((1.0 - 2.0 * cds[:, None, :])
+                                       * logits)
+                    * cmk[:, None, :] * pmask[:, :, None])
+            if K > 0:
+                seed = jax.random.bits(kn, (), jnp.uint32)
+                negs = lcg_negatives(seed, span, K, neg_table)
+                tgt = jnp.concatenate([centers[:, None], negs], axis=1)
+                tmask = jnp.concatenate(
+                    [jnp.ones((span, 1), jnp.float32),
+                     (negs != centers[:, None]).astype(jnp.float32)],
+                    axis=1)                        # (b, 1+K)
+                lbl = jnp.concatenate(
+                    [jnp.ones((1,), jnp.float32),
+                     jnp.zeros((K,), jnp.float32)])
+                w = syn1neg[tgt]                   # (b, 1+K, d)
+                logits = jnp.einsum("bcd,bkd->bck", h, w)
+                g = ((lbl[None, None, :] - jax.nn.sigmoid(logits))
+                     * tmask[:, None, :] * pmask[:, :, None] * alpha)
+                syn1neg = syn1neg.at[tgt].add(
+                    jnp.einsum("bck,bcd->bkd", g, h))
+                dns = jnp.einsum("bck,bkd->bcd", g, w)
+                d_syn0 = dns if d_syn0 is None else d_syn0 + dns
+                loss = loss - jnp.sum(
+                    jax.nn.log_sigmoid(
+                        jnp.where(lbl[None, None, :] > 0, logits,
+                                  -logits))
+                    * tmask[:, None, :] * pmask[:, :, None])
+            syn0 = syn0.at[words].add(d_syn0)
+            return (syn0, syn1, syn1neg, pair_count + jnp.sum(pmask),
+                    loss_sum + loss), None
+
+        init = (syn0, syn1, syn1neg, jnp.float32(0.0), jnp.float32(0.0))
+        xs = (jnp.arange(n_spans), alphas, span_keys)
+        (syn0, syn1, syn1neg, pairs, loss), _ = jax.lax.scan(
+            body, init, xs)
+        return syn0, syn1, syn1neg, pairs, loss
+
+    return jax.jit(epoch, donate_argnums=(0, 1, 2))
+
+
+class DeviceSkipGram:
+    """Device-resident corpus pipeline bound to a ``SequenceVectors``
+    instance (skip-gram only; CBOW keeps the host path)."""
+
+    def __init__(self, sv, seqs: List[np.ndarray]):
+        self.sv = sv
+        W = sv.window_size
+        # Span sized so EXPECTED live pairs per update step track the
+        # host path's divergence clamp (``_effective_batch``): the
+        # dynamic shrink leaves ~(W+1)/2W of the grid live, so
+        # span = eff / (live_frac * 2W) puts ~eff real pairs in each
+        # batched scatter — the regime the host path was stabilized
+        # for.  (Sentence boundaries only lower occupancy further.)
+        eff = max(64, sv._effective_batch())
+        live_frac = (W + 1) / (2 * W)
+        self.span = max(16, int(eff / (live_frac * 2 * W)))
+        corpus, sent, n = build_corpus_arrays(seqs, self.span)
+        self.n_words = n
+        self.npad = corpus.shape[0]
+        self.n_spans = self.npad // self.span
+        self.corpus = jnp.asarray(corpus)
+        self.sent = jnp.asarray(sent)
+        keep = keep_probabilities(sv.vocab, sv.sampling)
+        self.keep_prob = (jnp.asarray(keep) if keep is not None
+                          else jnp.ones((1,), jnp.float32))
+        if sv.negative > 0:
+            self.neg_table = jnp.asarray(block_negative_table(
+                sv.lookup_table.negative_table(), int(sv.negative),
+                sv.seed))
+        else:
+            self.neg_table = jnp.zeros((1, 1), jnp.int32)
+        if sv.use_hs:
+            self.hs_points, self.hs_codes, self.hs_cmask = sv._code_arrays
+        else:
+            z = jnp.zeros((1, 1))
+            self.hs_points = jnp.zeros((1, 1), jnp.int32)
+            self.hs_codes, self.hs_cmask = z, z
+        self._fn = _epoch_fn(W, int(sv.negative), sv.use_hs, self.span,
+                             self.n_spans, sv.sampling > 0, self.npad)
+        self.pairs_trained = 0.0
+        self.loss_sum = 0.0
+        self._pending = []      # per-pass lazy (pairs, loss) device scalars
+
+    def run_pass(self, pass_idx: int, total_words: int) -> None:
+        """One full corpus pass (epoch x iteration): compute the span
+        lr schedule on host, dispatch the scan, keep counters as lazy
+        device scalars (fetch = completion barrier, done in finish())."""
+        sv = self.sv
+        seen0 = pass_idx * self.n_words
+        starts = seen0 + np.arange(self.n_spans) * self.span
+        alphas = np.maximum(
+            sv.min_learning_rate,
+            sv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
+        key = jax.random.fold_in(jax.random.PRNGKey(sv.seed), pass_idx)
+        lt = sv.lookup_table
+        syn1 = lt.syn1 if sv.use_hs else jnp.zeros((1, 1), jnp.float32)
+        syn1neg = (lt.syn1neg if sv.negative > 0
+                   else jnp.zeros((1, 1), jnp.float32))
+        syn0, syn1, syn1neg, pairs, loss = self._fn(
+            lt.syn0, syn1, syn1neg, self.corpus, self.sent,
+            jnp.int32(self.n_words), self.keep_prob, self.neg_table,
+            self.hs_points, self.hs_codes, self.hs_cmask,
+            jnp.asarray(alphas.astype(np.float32)), key)
+        lt.syn0 = syn0
+        if sv.use_hs:
+            lt.syn1 = syn1
+        if sv.negative > 0:
+            lt.syn1neg = syn1neg
+        self._pending.append((pairs, loss))
+
+    def finish(self) -> Tuple[float, float]:
+        """Fetch and sum every pending pass's counters (the
+        device->host barrier; counters stay lazy until here so passes
+        dispatch back-to-back).  Totals accumulate across run_pass calls
+        since construction — 'pairs_trained' means ALL passes."""
+        for pairs, loss in self._pending:
+            self.pairs_trained += float(np.asarray(pairs))
+            self.loss_sum += float(np.asarray(loss))
+        self._pending = []
+        return self.pairs_trained, self.loss_sum

@@ -39,11 +39,11 @@ Array = jax.Array
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0: Array, syn1: Array, inputs: Array, points: Array,
-             codes: Array, code_mask: Array, pair_mask: Array,
-             lr: Array):
-    """Hierarchical-softmax batch update.
+def _hs_update(syn0: Array, syn1: Array, inputs: Array, points: Array,
+               codes: Array, code_mask: Array, pair_mask: Array,
+               lr: Array):
+    """Hierarchical-softmax batch update math (shared by the jitted
+    ``_hs_step`` and the on-device corpus pipeline's scan body).
 
     inputs (B,): syn0 rows (the context word in skip-gram; the averaged
     window is handled by the CBOW kernel).  points/codes/code_mask (B, L):
@@ -63,10 +63,14 @@ def _hs_step(syn0: Array, syn1: Array, inputs: Array, points: Array,
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _ns_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
-             labels: Array, target_mask: Array, pair_mask: Array, lr: Array):
-    """Negative-sampling batch update (the ``AggregateSkipGram`` role).
+_hs_step = jax.jit(_hs_update, donate_argnums=(0, 1))
+
+
+def _ns_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
+               labels: Array, target_mask: Array, pair_mask: Array,
+               lr: Array):
+    """Negative-sampling batch update math (the ``AggregateSkipGram``
+    role; shared by the jitted ``_ns_step`` and the device pipeline).
 
     targets (B, 1+K): positive word then K negatives; labels (1+K,) is
     [1, 0, ..., 0].  target_mask (B, 1+K) zeroes residual negative-sample
@@ -83,6 +87,9 @@ def _ns_step(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
     loss = -jnp.sum(jax.nn.log_sigmoid(
         jnp.where(labels[None, :] > 0, logits, -logits)) * mask)
     return syn0, syn1neg, loss
+
+
+_ns_step = jax.jit(_ns_update, donate_argnums=(0, 1))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -153,7 +160,8 @@ class SequenceVectors:
                  use_hierarchic_softmax: bool = True, sampling: float = 0.0,
                  batch_size: int = 2048, seed: int = 42,
                  elements_learning_algorithm: str = "skipgram",
-                 max_code_length: int = 40):
+                 max_code_length: int = 40,
+                 pair_generation: str = "auto"):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -168,6 +176,11 @@ class SequenceVectors:
         self.seed = seed
         self.algorithm = elements_learning_algorithm.lower()
         self.max_code_length = max_code_length
+        if pair_generation not in ("auto", "host", "device"):
+            raise ValueError(
+                f"unknown pair_generation {pair_generation!r}; expected "
+                "'auto', 'host', or 'device'")
+        self.pair_generation = pair_generation
         if not self.use_hs and self.negative <= 0:
             raise ValueError(
                 "Enable hierarchical softmax and/or negative sampling")
@@ -274,13 +287,96 @@ class SequenceVectors:
         return ctx[keep], cmask[keep], indices[keep]
 
     # ------------------------------------------------------------- training
+    #: "auto" routes to the on-device pipeline above this many corpus
+    #: words (compile cost amortizes; tiny corpora keep the host path's
+    #: sequential-update fidelity).
+    DEVICE_PIPELINE_MIN_WORDS = 100_000
+
+    def _device_eligible(self, seq_list) -> bool:
+        if self.algorithm != "skipgram":
+            return False
+        if self.pair_generation == "host":
+            return False
+        # Subclasses that customize ANY hook of the feeding loop keep
+        # their loop — the device scan would silently bypass overrides.
+        for hook in ("_train_sequence", "_generate_pairs",
+                     "_subsample_keep", "_sequence_to_indices"):
+            if getattr(type(self), hook) is not getattr(SequenceVectors,
+                                                        hook):
+                return False
+        if self.pair_generation == "device":
+            return True
+        n = sum(len(s) for s in seq_list)
+        return n >= self.DEVICE_PIPELINE_MIN_WORDS
+
+    def _fit_device(self, seq_list, source=None) -> "SequenceVectors":
+        """On-device corpus pipeline: one scan dispatch per corpus pass
+        (see ``nlp/device_corpus.py``).
+
+        The built pipeline (indexed corpus + device arrays + compiled
+        epoch fn) is CACHED across fit() calls keyed on the identity of
+        the caller's ``sequences`` object and the vocab — re-fitting the
+        same corpus (more epochs, lr sweeps) skips the ~0.3 s/M-words
+        host re-indexing and the corpus re-upload.  Mutating the same
+        sequence object in place between fits is not detected (the
+        ingest-cache posture: data is immutable while training on it)."""
+        from .device_corpus import DeviceSkipGram
+        # Everything the pipeline bakes in at construction: a change to
+        # any of these must invalidate the cache (learning_rate/epochs/
+        # iterations are re-read per pass and may change freely).
+        conf_key = (self.window_size, self.negative, self.use_hs,
+                    self.sampling, self.batch_size, self.seed)
+        cached = getattr(self, "_device_fit_cache", None)
+        if (cached is not None and source is not None
+                and cached[0] is source and cached[1] is self.vocab
+                and cached[2] == conf_key):
+            pipe = cached[3]
+        else:
+            seqs = [self._sequence_to_indices(s) for s in seq_list]
+            seqs = [s for s in seqs if s.size >= 2]
+            if not seqs:
+                return self
+            pipe = DeviceSkipGram(self, seqs)
+            if source is not None:
+                self._device_fit_cache = (source, self.vocab, conf_key,
+                                          pipe)
+        passes = self.epochs * self.iterations
+        total_words = pipe.n_words * passes
+        prev_pairs, prev_loss = pipe.pairs_trained, pipe.loss_sum
+        for p in range(passes):
+            pipe.run_pass(p, total_words)
+        pipe.finish()
+        # Deltas: the cached pipe's counters span its whole lifetime;
+        # the stats contract is THIS fit (all of its passes).
+        self._device_pipeline_stats = {
+            "pairs_trained": pipe.pairs_trained - prev_pairs,
+            "loss_sum": pipe.loss_sum - prev_loss,
+            "passes": passes, "span": pipe.span,
+            "n_spans": pipe.n_spans}
+        return self
+
     def fit(self, sequences) -> "SequenceVectors":
         """The reference fit pipeline (``SequenceVectors.java:179``):
-        build vocab -> Huffman -> train ``epochs`` passes."""
+        build vocab -> Huffman -> train ``epochs`` passes.
+
+        Skip-gram corpora route through the on-device pair-generation
+        pipeline (``pair_generation="auto"|"device"``; window sampling,
+        subsampling and negative draws all on-chip — the reference's
+        feeding loop around ``SkipGram.java:258`` moved onto the
+        device); CBOW and small corpora use the host loop."""
+        cached = getattr(self, "_device_fit_cache", None)
+        if (cached is not None and cached[0] is sequences
+                and cached[1] is self.vocab
+                and cached[2] == (self.window_size, self.negative,
+                                  self.use_hs, self.sampling,
+                                  self.batch_size, self.seed)):
+            return self._fit_device(None, source=sequences)
         seq_list = [list(s) for s in sequences]
         if self.vocab is None:
             self.build_vocab(seq_list)
         self._reset_queues()  # drop stale pairs from an aborted prior fit
+        if self._device_eligible(seq_list):
+            return self._fit_device(seq_list, source=sequences)
         total_words = sum(len(s) for s in seq_list) * self.epochs \
             * self.iterations
         words_seen = 0
