@@ -359,6 +359,7 @@ class DeviceSkipGram:
         self.pairs_trained = 0.0
         self.loss_sum = 0.0
         self._pending = []      # per-pass lazy (pairs, loss) device scalars
+        self._passes_run = 0    # lifetime counter: fresh RNG every pass
 
     def run_pass(self, pass_idx: int, total_words: int) -> None:
         """One full corpus pass (epoch x iteration): compute the span
@@ -370,7 +371,12 @@ class DeviceSkipGram:
         alphas = np.maximum(
             sv.min_learning_rate,
             sv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
-        key = jax.random.fold_in(jax.random.PRNGKey(sv.seed), pass_idx)
+        # Key off the LIFETIME pass count, not pass_idx: a cached pipe
+        # re-fit with pass_idx restarting at 0 would otherwise replay
+        # the exact same subsampling/shrink/negative draws every fit.
+        key = jax.random.fold_in(jax.random.PRNGKey(sv.seed),
+                                 self._passes_run)
+        self._passes_run += 1
         lt = sv.lookup_table
         syn1 = lt.syn1 if sv.use_hs else jnp.zeros((1, 1), jnp.float32)
         syn1neg = (lt.syn1neg if sv.negative > 0

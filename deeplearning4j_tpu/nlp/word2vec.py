@@ -300,7 +300,8 @@ class SequenceVectors:
         # Subclasses that customize ANY hook of the feeding loop keep
         # their loop — the device scan would silently bypass overrides.
         for hook in ("_train_sequence", "_generate_pairs",
-                     "_subsample_keep", "_sequence_to_indices"):
+                     "_subsample_keep", "_sequence_to_indices",
+                     "_draw_negatives", "_skipgram_batch"):
             if getattr(type(self), hook) is not getattr(SequenceVectors,
                                                         hook):
                 return False
@@ -308,6 +309,15 @@ class SequenceVectors:
             return True
         n = sum(len(s) for s in seq_list)
         return n >= self.DEVICE_PIPELINE_MIN_WORDS
+
+    def _device_conf_key(self):
+        """Everything the device pipeline bakes in at construction plus
+        the routing knobs: a change to any of these must invalidate the
+        pipeline cache (learning_rate/epochs/iterations are re-read per
+        pass and may change freely)."""
+        return (self.window_size, self.negative, self.use_hs,
+                self.sampling, self.batch_size, self.seed,
+                self.pair_generation, self.algorithm)
 
     def _fit_device(self, seq_list, source=None) -> "SequenceVectors":
         """On-device corpus pipeline: one scan dispatch per corpus pass
@@ -321,11 +331,7 @@ class SequenceVectors:
         sequence object in place between fits is not detected (the
         ingest-cache posture: data is immutable while training on it)."""
         from .device_corpus import DeviceSkipGram
-        # Everything the pipeline bakes in at construction: a change to
-        # any of these must invalidate the cache (learning_rate/epochs/
-        # iterations are re-read per pass and may change freely).
-        conf_key = (self.window_size, self.negative, self.use_hs,
-                    self.sampling, self.batch_size, self.seed)
+        conf_key = self._device_conf_key()
         cached = getattr(self, "_device_fit_cache", None)
         if (cached is not None and source is not None
                 and cached[0] is source and cached[1] is self.vocab
@@ -367,9 +373,7 @@ class SequenceVectors:
         cached = getattr(self, "_device_fit_cache", None)
         if (cached is not None and cached[0] is sequences
                 and cached[1] is self.vocab
-                and cached[2] == (self.window_size, self.negative,
-                                  self.use_hs, self.sampling,
-                                  self.batch_size, self.seed)):
+                and cached[2] == self._device_conf_key()):
             return self._fit_device(None, source=sequences)
         seq_list = [list(s) for s in sequences]
         if self.vocab is None:

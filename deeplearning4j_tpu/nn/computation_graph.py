@@ -1003,12 +1003,16 @@ class ComputationGraph:
 
     # ------------------------------------------------ flat-param invariant
     def param_table(self) -> Dict[str, np.ndarray]:
+        from ..utils.device import fetch_all
         self.init()
-        out = {}
+        dev = {}
         for name in self._layer_names():
             for p in self.vertices[name].layer.param_order():
-                out[f"{name}_{p}"] = np.asarray(self.params[name][p])
-        return out
+                dev[f"{name}_{p}"] = self.params[name][p]
+        # fetch_all: per-array synchronous np.asarray costs one full
+        # host<->device round trip EACH (~320 arrays x ~100 ms tunnel
+        # RTT = ~30 s per StatsListener post on ResNet-50).
+        return dict(zip(dev, fetch_all(dev.values())))
 
     def num_params(self) -> int:
         self.init()
@@ -1017,11 +1021,12 @@ class ComputationGraph:
                    for p in jax.tree_util.tree_leaves(tree))
 
     def get_flat_params(self) -> np.ndarray:
+        from ..utils.device import fetch_all
         self.init()
-        chunks = []
-        for name in self._layer_names():
-            for p in self.vertices[name].layer.param_order():
-                chunks.append(np.asarray(self.params[name][p]).ravel())
+        dev = [self.params[name][p]
+               for name in self._layer_names()
+               for p in self.vertices[name].layer.param_order()]
+        chunks = [a.ravel() for a in fetch_all(dev)]
         if not chunks:
             return np.zeros((0,), np.float32)
         return np.concatenate(chunks)

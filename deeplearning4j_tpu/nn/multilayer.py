@@ -994,12 +994,13 @@ class MultiLayerNetwork:
     def param_table(self) -> Dict[str, np.ndarray]:
         """Named params ``{"0_W": ..., "0_b": ...}`` (reference
         ``paramTable()`` naming)."""
+        from ..utils.device import fetch_all
         self.init()
-        out = {}
+        dev = {}
         for i, layer in enumerate(self.layers):
             for name in layer.param_order():
-                out[f"{i}_{name}"] = np.asarray(self.params[i][name])
-        return out
+                dev[f"{i}_{name}"] = self.params[i][name]
+        return dict(zip(dev, fetch_all(dev.values())))
 
     def num_params(self) -> int:
         self.init()
@@ -1010,11 +1011,12 @@ class MultiLayerNetwork:
     def get_flat_params(self) -> np.ndarray:
         """One contiguous vector over all params in deterministic layer/param
         order — the reference's single flat buffer (``init():396-470``)."""
+        from ..utils.device import fetch_all
         self.init()
-        chunks = []
-        for i, layer in enumerate(self.layers):
-            for name in layer.param_order():
-                chunks.append(np.asarray(self.params[i][name]).ravel())
+        dev = [self.params[i][name]
+               for i, layer in enumerate(self.layers)
+               for name in layer.param_order()]
+        chunks = [a.ravel() for a in fetch_all(dev)]
         if not chunks:
             return np.zeros((0,), np.float32)
         return np.concatenate(chunks)

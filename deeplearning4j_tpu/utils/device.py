@@ -1,0 +1,22 @@
+"""Host-fetch helpers for device arrays.
+
+``np.asarray`` on a jax Array is a SYNCHRONOUS device->host transfer:
+fetching N arrays in a loop costs N full round trips.  On a tunneled
+TPU with ~100 ms RTT that turned every StatsListener post / checkpoint
+write on ResNet-50 (~320 param arrays) into ~30 s of serial RTTs.
+Starting all copies with ``copy_to_host_async`` before the first
+blocking convert overlaps them into ~one round trip.
+"""
+
+from typing import Iterable, List
+
+import numpy as np
+
+
+def fetch_all(arrays: Iterable) -> List[np.ndarray]:
+    """numpy copies of many device arrays, copies started async first."""
+    arrays = list(arrays)
+    for a in arrays:
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
+    return [np.asarray(a) for a in arrays]

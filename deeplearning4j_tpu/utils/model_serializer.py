@@ -104,21 +104,25 @@ def _flatten_state(net):
     """Layer state (BN running stats etc.) -> flat vector + shape manifest."""
     import jax
 
+    from .device import fetch_all
+
     chunks, manifest = [], []
     offset = 0
-    items = (net.net_state.items() if isinstance(net.net_state, dict)
-             else enumerate(net.net_state))
-    for i, tree in items:
-        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-            arr = np.asarray(leaf)
-            manifest.append({
-                "layer": i,
-                "path": "/".join(str(getattr(p, "key", p)) for p in path),
-                "shape": list(arr.shape),
-                "offset": offset,
-            })
-            chunks.append(arr.ravel())
-            offset += arr.size
+    items = list(net.net_state.items() if isinstance(net.net_state, dict)
+                 else enumerate(net.net_state))
+    flat_items = [(i, path, leaf) for i, tree in items
+                  for path, leaf in jax.tree_util.tree_flatten_with_path(
+                      tree)[0]]
+    fetched = fetch_all(leaf for _, _, leaf in flat_items)
+    for (i, path, _), arr in zip(flat_items, fetched):
+        manifest.append({
+            "layer": i,
+            "path": "/".join(str(getattr(p, "key", p)) for p in path),
+            "shape": list(arr.shape),
+            "offset": offset,
+        })
+        chunks.append(arr.ravel())
+        offset += arr.size
     if not chunks:
         return np.zeros((0,), np.float32), manifest
     return np.concatenate(chunks), manifest

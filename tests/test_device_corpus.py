@@ -239,3 +239,18 @@ def test_host_and_device_agree_on_quality():
         inter = np.mean([sv.similarity("a1", "b%d" % i)
                          for i in range(2, 8)])
         assert intra > inter + 0.15
+
+def test_cached_pipe_fresh_rng_each_fit():
+    """A cached pipeline must NOT replay the same RNG stream on repeat
+    fits: with subsampling on, identical draws would reproduce the exact
+    pair count; fresh per-pass keys make the counts differ."""
+    rng = np.random.RandomState(11)
+    seqs = _cluster_corpus(rng, n_sent=200)
+    sv = SequenceVectors(layer_size=8, window_size=3, epochs=1,
+                         sampling=1e-3, min_word_frequency=1,
+                         pair_generation="device")
+    sv.fit(seqs)
+    first = sv._device_pipeline_stats["pairs_trained"]
+    sv.fit(seqs)     # cached pipe, fresh keys
+    second = sv._device_pipeline_stats["pairs_trained"]
+    assert first != second

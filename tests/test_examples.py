@@ -71,3 +71,14 @@ def test_native_inference_example_executes():
     mod = _run("native_inference.py")
     result = mod["main"]()
     assert result in (True, None)   # None = no PJRT plugin (said why)
+
+
+def test_sustained_training_example_executes():
+    """Tiny real run of the sustained-training proof harness: the full
+    listener stack (Performance + Checkpoint + Stats) attached to a
+    real fit through the device epoch cache, eval at the end."""
+    mod = _run("sustained_training.py")
+    r = mod["sustained_lenet"](epochs=2, batch=64, examples=640)
+    assert r["iterations"] == 20 and 0.0 <= r["accuracy"] <= 1.0
+    r = mod["sustained_resnet"](steps=2, batch=2, examples=4)
+    assert r["timed_steps"] == 2 and r["checkpoints"] >= 0
