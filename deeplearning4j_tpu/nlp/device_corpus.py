@@ -221,7 +221,8 @@ def lcg_negatives(seed: Array, rows: int, k: int, table_2d: Array):
 
 @functools.lru_cache(maxsize=8)
 def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
-              n_spans: int, subsample: bool, npad: int):
+              n_spans: int, subsample: bool, npad: int,
+              algorithm: str = "skipgram"):
     """Build + jit the one-pass scan.  All shape-determining config is
     in the cache key; arrays are traced arguments.
 
@@ -255,6 +256,8 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
         corpus_pad, sent_pad = pad_with_sentinels(corpus, sent, window)
         span_keys = jax.random.split(key, n_spans)
 
+        cbow = algorithm == "cbow"
+
         def body(carry, xs):
             syn0, syn1, syn1neg, pair_count, loss_sum = carry
             c, alpha, ckey = xs
@@ -262,24 +265,44 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
             shrink = jax.random.randint(kb, (span,), 0, window)
             words, centers, pmask = pair_grid_shaped(
                 corpus_pad, sent_pad, c * span, shrink, window, span)
-            h = syn0[words]                        # (b, 2W, d)
+            hc = syn0[words]                       # (b, 2W, d)
+            if cbow:
+                # CBOW: ONE example per center — h is the masked MEAN
+                # of the window's vectors; the input-side gradient dh
+                # goes to every context word un-divided
+                # (word2vec.c / reference AggregateCBOW semantics,
+                # host twin ``_cbow_hs_step``/``_cbow_ns_step``).
+                counts = jnp.sum(pmask, axis=1)
+                exmask = (counts > 0).astype(jnp.float32)   # (b,)
+                h = (jnp.einsum("bcd,bc->bd", hc, pmask)
+                     / jnp.maximum(counts, 1.0)[:, None])   # (b, d)
             loss = jnp.float32(0.0)
-            d_syn0 = None                          # (b, 2W, d) cotangent
+            d_syn0 = None
             if use_hs:
                 pts = hs_points[centers]           # (b, L)
                 cds = hs_codes[centers]
                 cmk = hs_cmask[centers]
                 w = syn1[pts]                      # (b, L, d)
-                logits = jnp.einsum("bcd,bld->bcl", h, w)
-                g = ((1.0 - cds[:, None, :] - jax.nn.sigmoid(logits))
-                     * cmk[:, None, :] * pmask[:, :, None] * alpha)
-                syn1 = syn1.at[pts].add(
-                    jnp.einsum("bcl,bcd->bld", g, h))
-                d_syn0 = jnp.einsum("bcl,bld->bcd", g, w)
-                loss = loss - jnp.sum(
-                    jax.nn.log_sigmoid((1.0 - 2.0 * cds[:, None, :])
-                                       * logits)
-                    * cmk[:, None, :] * pmask[:, :, None])
+                if cbow:
+                    logits = jnp.einsum("bd,bld->bl", h, w)
+                    g = ((1.0 - cds - jax.nn.sigmoid(logits))
+                         * cmk * exmask[:, None] * alpha)
+                    syn1 = syn1.at[pts].add(g[:, :, None] * h[:, None, :])
+                    d_syn0 = jnp.einsum("bl,bld->bd", g, w)
+                    loss = loss - jnp.sum(
+                        jax.nn.log_sigmoid((1.0 - 2.0 * cds) * logits)
+                        * cmk * exmask[:, None])
+                else:
+                    logits = jnp.einsum("bcd,bld->bcl", hc, w)
+                    g = ((1.0 - cds[:, None, :] - jax.nn.sigmoid(logits))
+                         * cmk[:, None, :] * pmask[:, :, None] * alpha)
+                    syn1 = syn1.at[pts].add(
+                        jnp.einsum("bcl,bcd->bld", g, hc))
+                    d_syn0 = jnp.einsum("bcl,bld->bcd", g, w)
+                    loss = loss - jnp.sum(
+                        jax.nn.log_sigmoid((1.0 - 2.0 * cds[:, None, :])
+                                           * logits)
+                        * cmk[:, None, :] * pmask[:, :, None])
             if K > 0:
                 seed = jax.random.bits(kn, (), jnp.uint32)
                 negs = lcg_negatives(seed, span, K, neg_table)
@@ -292,20 +315,41 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     [jnp.ones((1,), jnp.float32),
                      jnp.zeros((K,), jnp.float32)])
                 w = syn1neg[tgt]                   # (b, 1+K, d)
-                logits = jnp.einsum("bcd,bkd->bck", h, w)
-                g = ((lbl[None, None, :] - jax.nn.sigmoid(logits))
-                     * tmask[:, None, :] * pmask[:, :, None] * alpha)
-                syn1neg = syn1neg.at[tgt].add(
-                    jnp.einsum("bck,bcd->bkd", g, h))
-                dns = jnp.einsum("bck,bkd->bcd", g, w)
-                d_syn0 = dns if d_syn0 is None else d_syn0 + dns
-                loss = loss - jnp.sum(
-                    jax.nn.log_sigmoid(
-                        jnp.where(lbl[None, None, :] > 0, logits,
-                                  -logits))
-                    * tmask[:, None, :] * pmask[:, :, None])
-            syn0 = syn0.at[words].add(d_syn0)
-            return (syn0, syn1, syn1neg, pair_count + jnp.sum(pmask),
+                if cbow:
+                    logits = jnp.einsum("bd,bkd->bk", h, w)
+                    g = ((lbl[None, :] - jax.nn.sigmoid(logits))
+                         * tmask * exmask[:, None] * alpha)
+                    syn1neg = syn1neg.at[tgt].add(
+                        g[:, :, None] * h[:, None, :])
+                    dns = jnp.einsum("bk,bkd->bd", g, w)
+                    d_syn0 = dns if d_syn0 is None else d_syn0 + dns
+                    loss = loss - jnp.sum(
+                        jax.nn.log_sigmoid(
+                            jnp.where(lbl[None, :] > 0, logits, -logits))
+                        * tmask * exmask[:, None])
+                else:
+                    logits = jnp.einsum("bcd,bkd->bck", hc, w)
+                    g = ((lbl[None, None, :] - jax.nn.sigmoid(logits))
+                         * tmask[:, None, :] * pmask[:, :, None] * alpha)
+                    syn1neg = syn1neg.at[tgt].add(
+                        jnp.einsum("bck,bcd->bkd", g, hc))
+                    dns = jnp.einsum("bck,bkd->bcd", g, w)
+                    d_syn0 = dns if d_syn0 is None else d_syn0 + dns
+                    loss = loss - jnp.sum(
+                        jax.nn.log_sigmoid(
+                            jnp.where(lbl[None, None, :] > 0, logits,
+                                      -logits))
+                        * tmask[:, None, :] * pmask[:, :, None])
+            if cbow:
+                # the (b, d) example gradient fans out to every live
+                # context cell (un-divided — word2vec.c neu1e semantics)
+                syn0 = syn0.at[words].add(
+                    d_syn0[:, None, :] * pmask[:, :, None])
+                trained = jnp.sum(exmask)
+            else:
+                syn0 = syn0.at[words].add(d_syn0)
+                trained = jnp.sum(pmask)
+            return (syn0, syn1, syn1neg, pair_count + trained,
                     loss_sum + loss), None
 
         init = (syn0, syn1, syn1neg, jnp.float32(0.0), jnp.float32(0.0))
@@ -319,20 +363,24 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
 
 class DeviceSkipGram:
     """Device-resident corpus pipeline bound to a ``SequenceVectors``
-    instance (skip-gram only; CBOW keeps the host path)."""
+    instance (skip-gram and CBOW element-learning algorithms)."""
 
     def __init__(self, sv, seqs: List[np.ndarray]):
         self.sv = sv
         W = sv.window_size
-        # Span sized so EXPECTED live pairs per update step track the
-        # host path's divergence clamp (``_effective_batch``): the
-        # dynamic shrink leaves ~(W+1)/2W of the grid live, so
-        # span = eff / (live_frac * 2W) puts ~eff real pairs in each
-        # batched scatter — the regime the host path was stabilized
-        # for.  (Sentence boundaries only lower occupancy further.)
+        # Span sized so EXPECTED live examples per update step track
+        # the host path's divergence clamp (``_effective_batch``).
+        # Skip-gram: the dynamic shrink leaves ~(W+1)/2W of the grid
+        # live, so span = eff / (live_frac * 2W) puts ~eff real pairs
+        # in each batched scatter — the regime the host path was
+        # stabilized for (sentence boundaries only lower occupancy).
+        # CBOW trains ONE example per center, so span = eff directly.
         eff = max(64, sv._effective_batch())
-        live_frac = (W + 1) / (2 * W)
-        self.span = max(16, int(eff / (live_frac * 2 * W)))
+        if sv.algorithm == "cbow":
+            self.span = max(16, eff)
+        else:
+            live_frac = (W + 1) / (2 * W)
+            self.span = max(16, int(eff / (live_frac * 2 * W)))
         corpus, sent, n = build_corpus_arrays(seqs, self.span)
         self.n_words = n
         self.npad = corpus.shape[0]
@@ -355,7 +403,8 @@ class DeviceSkipGram:
             self.hs_points = jnp.zeros((1, 1), jnp.int32)
             self.hs_codes, self.hs_cmask = z, z
         self._fn = _epoch_fn(W, int(sv.negative), sv.use_hs, self.span,
-                             self.n_spans, sv.sampling > 0, self.npad)
+                             self.n_spans, sv.sampling > 0, self.npad,
+                             sv.algorithm)
         self.pairs_trained = 0.0
         self.loss_sum = 0.0
         self._pending = []      # per-pass lazy (pairs, loss) device scalars

@@ -293,7 +293,7 @@ class SequenceVectors:
     DEVICE_PIPELINE_MIN_WORDS = 100_000
 
     def _device_eligible(self, seq_list) -> bool:
-        if self.algorithm != "skipgram":
+        if self.algorithm not in ("skipgram", "cbow"):
             return False
         if self.pair_generation == "host":
             return False
@@ -301,7 +301,8 @@ class SequenceVectors:
         # their loop — the device scan would silently bypass overrides.
         for hook in ("_train_sequence", "_generate_pairs",
                      "_subsample_keep", "_sequence_to_indices",
-                     "_draw_negatives", "_skipgram_batch"):
+                     "_draw_negatives", "_skipgram_batch",
+                     "_generate_cbow", "_cbow_batch"):
             if getattr(type(self), hook) is not getattr(SequenceVectors,
                                                         hook):
                 return False
@@ -369,7 +370,8 @@ class SequenceVectors:
         pipeline (``pair_generation="auto"|"device"``; window sampling,
         subsampling and negative draws all on-chip — the reference's
         feeding loop around ``SkipGram.java:258`` moved onto the
-        device); CBOW and small corpora use the host loop."""
+        device, for both skip-gram and CBOW element algorithms); small
+        corpora and subclassed feeding loops use the host loop."""
         cached = getattr(self, "_device_fit_cache", None)
         if (cached is not None and cached[0] is sequences
                 and cached[1] is self.vocab

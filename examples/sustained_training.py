@@ -48,7 +48,8 @@ def _listeners(ckpt_dir, every_iter, stats_freq=50):
 
 
 def sustained_lenet(epochs: int = 15, batch: int = 256,
-                    examples: int = 60000, target_acc: float = 0.99):
+                    examples: int = 60000, target_acc: float = 0.99,
+                    ckpt_every: int = 500, stats_freq: int = 50):
     """Full-MNIST LeNet through fit(iterator) (device epoch cache) to
     >= target accuracy, with the listener stack attached."""
     from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
@@ -57,7 +58,9 @@ def sustained_lenet(epochs: int = 15, batch: int = 256,
 
     net = MultiLayerNetwork(lenet(compute_dtype=_bf16_if_tpu())).init()
     with tempfile.TemporaryDirectory() as ckpt_dir:
-        listeners, storage, ckpt = _listeners(ckpt_dir, every_iter=500)
+        listeners, storage, ckpt = _listeners(ckpt_dir,
+                                              every_iter=ckpt_every,
+                                              stats_freq=stats_freq)
         net.set_listeners(*listeners)
         it = MnistDataSetIterator(batch, examples)
         test = MnistDataSetIterator(500, 10000, train=False)

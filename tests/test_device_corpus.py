@@ -215,7 +215,15 @@ def test_auto_routing_thresholds():
     sv_cbow = SequenceVectors(layer_size=8, min_word_frequency=1,
                               pair_generation="device",
                               elements_learning_algorithm="cbow")
-    assert not sv_cbow._device_eligible(seqs)     # CBOW keeps host loop
+    assert sv_cbow._device_eligible(seqs)         # CBOW device path too
+
+    class CustomNeg(SequenceVectors):
+        def _draw_negatives(self, positives, B):
+            return super()._draw_negatives(positives, B)
+
+    custom = CustomNeg(layer_size=8, min_word_frequency=1,
+                       pair_generation="device")
+    assert not custom._device_eligible(seqs)      # overridden hook -> host
     with pytest.raises(ValueError):
         SequenceVectors(pair_generation="bogus")
 
@@ -254,3 +262,37 @@ def test_cached_pipe_fresh_rng_each_fit():
     sv.fit(seqs)     # cached pipe, fresh keys
     second = sv._device_pipeline_stats["pairs_trained"]
     assert first != second
+
+
+@pytest.mark.parametrize("hs,neg", [(True, 0.0), (False, 5.0)])
+def test_cbow_device_pipeline_learns_clusters(hs, neg):
+    rng = np.random.RandomState(13)
+    seqs = _cluster_corpus(rng)
+    sv = SequenceVectors(layer_size=24, window_size=3, epochs=3,
+                         negative=neg, use_hierarchic_softmax=hs,
+                         min_word_frequency=1, pair_generation="device",
+                         elements_learning_algorithm="cbow")
+    sv.fit(seqs)
+    stats = sv._device_pipeline_stats
+    # CBOW counts EXAMPLES (centers with a nonempty window), one per
+    # corpus position at most
+    assert 0 < stats["pairs_trained"] <= 400 * 12 * 3
+    intra = np.mean([sv.similarity("a1", "a%d" % i) for i in range(2, 8)])
+    inter = np.mean([sv.similarity("a1", "b%d" % i) for i in range(2, 8)])
+    assert intra > inter + 0.15
+
+
+def test_cbow_host_and_device_agree_on_quality():
+    rng = np.random.RandomState(14)
+    seqs = _cluster_corpus(rng, n_sent=300)
+    for pg in ("host", "device"):
+        sv = SequenceVectors(layer_size=24, window_size=3, epochs=3,
+                             negative=5.0, use_hierarchic_softmax=False,
+                             min_word_frequency=1, pair_generation=pg,
+                             elements_learning_algorithm="cbow")
+        sv.fit(seqs)
+        intra = np.mean([sv.similarity("a1", "a%d" % i)
+                         for i in range(2, 8)])
+        inter = np.mean([sv.similarity("a1", "b%d" % i)
+                         for i in range(2, 8)])
+        assert intra > inter + 0.15, (pg, intra, inter)

@@ -78,7 +78,11 @@ def test_sustained_training_example_executes():
     listener stack (Performance + Checkpoint + Stats) attached to a
     real fit through the device epoch cache, eval at the end."""
     mod = _run("sustained_training.py")
-    r = mod["sustained_lenet"](epochs=2, batch=64, examples=640)
+    r = mod["sustained_lenet"](epochs=2, batch=64, examples=640,
+                               ckpt_every=10, stats_freq=10)
     assert r["iterations"] == 20 and 0.0 <= r["accuracy"] <= 1.0
+    # 20 iterations at a 10-iteration cadence -> exactly 2 checkpoints
+    assert r["checkpoints"] == 2
+    assert r["stats_updates"] >= 1
     r = mod["sustained_resnet"](steps=2, batch=2, examples=4)
-    assert r["timed_steps"] == 2 and r["checkpoints"] >= 0
+    assert r["timed_steps"] == 2 and r["checkpoints"] == 0

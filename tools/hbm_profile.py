@@ -56,13 +56,9 @@ _INSTR_RE = re.compile(
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
 
 
-_COMP_START_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\([^)]*\))?"
-                            r"\s*->.*\{\s*$|^ENTRY\s")
-
-
-def profile_hlo(hlo_text: str, top_n: int = 10):
+def profile_hlo(hlo_text: str):
     """Parse optimized HLO; return (rows, total_bytes) where rows are
-    (bytes, op_kind, name, out_shape), largest first.
+    ALL (bytes, op_kind, name, out_shape) entries, largest first.
 
     Computation-aware: instructions INSIDE fusion bodies
     (``%fused_computation*``) and scalar reducer/comparator regions are
@@ -126,7 +122,7 @@ def profile_hlo(hlo_text: str, top_n: int = 10):
         rows.append((b, kind, name, out_shape))
         total += b
     rows.sort(reverse=True)
-    return rows[:top_n], total
+    return rows, total
 
 
 def _classify(kind: str, name: str, shape: str) -> str:
@@ -179,7 +175,8 @@ def main() -> int:
     if isinstance(cost, list):
         cost = cost[0]
     hlo = compiled.as_text()
-    rows, total = profile_hlo(hlo, top_n)
+    all_rows, total = profile_hlo(hlo)
+    rows = all_rows[:top_n]
     print(f"# {config}: top {top_n} HBM-consuming ops "
           f"(parsed {total/1e6:.0f} MB/step; XLA cost model "
           f"{cost.get('bytes accessed', 0)/1e6:.0f} MB/step)")
@@ -189,13 +186,7 @@ def main() -> int:
         cls = _classify(kind, name, shape)
         print(f"{b/1e6:8.1f}  {100*b/total:5.1f}  {cls:<8} {kind:<14} "
               f"{shape[:60]}  {name[:40]}")
-    for line in hlo.splitlines():
-        m = _INSTR_RE.match(line)
-        if m and m.group(3) not in ("parameter", "constant", "tuple",
-                                    "get-tuple-element", "while"):
-            pass
     # class totals over ALL instructions, not just top-n
-    all_rows, _ = profile_hlo(hlo, top_n=10 ** 9)
     for b, kind, name, shape in all_rows:
         by_class[_classify(kind, name, shape)] += b
     print("\n# traffic by op class (all instructions)")
