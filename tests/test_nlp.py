@@ -455,3 +455,115 @@ def test_word_vectors_mean_and_similar_words():
     assert sv.word_vectors(["zzz"]).shape == (0, 2)
     sim = sv.similar_words_in_vocab_to("might", 0.7)
     assert "night" in sim and "light" in sim and "apple" not in sim
+
+
+def test_glove_epoch_scan_matches_per_batch_loop():
+    """The one-dispatch-per-epoch GloVe must reproduce the per-batch
+    dispatch loop exactly (same shuffle stream, same chunking, same
+    mask padding) — the scan is a dispatch-structure change only."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.glove import Glove, _glove_step
+
+    rng = np.random.RandomState(5)
+    seqs = [["g%d" % w for w in rng.randint(0, 30, 20)] for _ in range(40)]
+    kw = dict(layer_size=12, window_size=3, epochs=2, batch_size=64,
+              min_word_frequency=1, seed=9)
+    g1 = Glove(**kw)
+    g1.fit(seqs)
+
+    # reference: the per-batch loop with the identical RNG stream
+    g2 = Glove(**kw)
+    g2.build_vocab([list(s) for s in seqs])
+    counts = g2._count_cooccurrences([list(s) for s in seqs])
+    pairs = np.array(list(counts.keys()), np.int32)
+    xs = np.array(list(counts.values()), np.float32)
+    logx = np.log(xs)
+    fx = np.minimum(1.0, (xs / g2.x_max) ** g2.alpha).astype(np.float32)
+    V, D = g2.vocab.num_words(), g2.layer_size
+    import jax
+    k1, k2 = jax.random.split(jax.random.PRNGKey(g2.seed))
+    W = ((jax.random.uniform(k1, (V, D), jnp.float32) - 0.5) / D)
+    Wc = ((jax.random.uniform(k2, (V, D), jnp.float32) - 0.5) / D)
+    b, bc = jnp.zeros((V,), jnp.float32), jnp.zeros((V,), jnp.float32)
+    hW = jnp.zeros((V, D), jnp.float32)
+    hWc = jnp.zeros((V, D), jnp.float32)
+    hb, hbc = (jnp.zeros((V,), jnp.float32),
+               jnp.zeros((V,), jnp.float32))
+    lr = jnp.float32(g2.learning_rate)
+    B, n = g2.batch_size, pairs.shape[0]
+    order = np.arange(n)
+    for _ in range(g2.epochs):
+        g2._rng.shuffle(order)
+        for s in range(0, n, B):
+            sel = order[s:s + B]
+            pad = B - sel.size
+            mask = np.concatenate([np.ones(sel.size, np.float32),
+                                   np.zeros(pad, np.float32)])
+            sel_p = np.concatenate([sel, np.zeros(pad, np.int64)])
+            (W, Wc, b, bc, hW, hWc, hb, hbc, _) = _glove_step(
+                W, Wc, b, bc, hW, hWc, hb, hbc,
+                jnp.asarray(pairs[sel_p, 0]), jnp.asarray(pairs[sel_p, 1]),
+                jnp.asarray(logx[sel_p]), jnp.asarray(fx[sel_p]),
+                jnp.asarray(mask), lr)
+    ref = np.asarray(W + Wc)
+    got = np.asarray(g1.lookup_table.syn0)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_glove_last_epoch_loss_monitoring():
+    rng = np.random.RandomState(19)
+    seqs = [["m%d" % w for w in rng.randint(0, 10, 15)] for _ in range(30)]
+    from deeplearning4j_tpu.nlp.glove import Glove
+    g1 = Glove(layer_size=8, window_size=2, epochs=1, min_word_frequency=1,
+               seed=3)
+    g1.fit(seqs)
+    g8 = Glove(layer_size=8, window_size=2, epochs=12, min_word_frequency=1,
+               seed=3)
+    g8.fit(seqs)
+    assert np.isfinite(g1.last_epoch_loss) and np.isfinite(g8.last_epoch_loss)
+    assert g8.last_epoch_loss < g1.last_epoch_loss   # training reduces it
+
+
+def test_glove_chunked_cooc_flush_matches_single_pass():
+    """Counting with a tiny dedup-chunk budget (forcing many flushes and
+    the final merge) must equal counting in one chunk."""
+    rng = np.random.RandomState(23)
+    seqs = [["k%d" % w for w in rng.randint(0, 20, 25)] for _ in range(30)]
+    from deeplearning4j_tpu.nlp.glove import Glove
+    g = Glove(layer_size=4, window_size=3, min_word_frequency=1)
+    g.build_vocab([list(s) for s in seqs])
+    one = g._count_cooccurrences([list(s) for s in seqs])
+    g.COOC_CHUNK_KEYS = 64          # force many flush/merge cycles
+    many = g._count_cooccurrences([list(s) for s in seqs])
+    assert one.keys() == many.keys()
+    for k in one:
+        assert many[k] == pytest.approx(one[k], rel=1e-12)
+
+
+def test_glove_cooccurrence_counts_match_brute_force():
+    """The vectorized unique/bincount counter must equal the textbook
+    per-position double loop (1/distance weights, symmetric mirror,
+    window clipped at sequence edges)."""
+    from collections import defaultdict
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    rng = np.random.RandomState(17)
+    seqs = [["c%d" % w for w in rng.randint(0, 12, n)]
+            for n in (1, 2, 5, 17, 30)]
+    for symmetric in (True, False):
+        g = Glove(layer_size=4, window_size=4, min_word_frequency=1,
+                  symmetric=symmetric)
+        g.build_vocab([list(s) for s in seqs])
+        got = g._count_cooccurrences([list(s) for s in seqs])
+        expect = defaultdict(float)
+        for seq in seqs:
+            idx = g._sequence_to_indices(seq)
+            for i in range(idx.size):
+                for j in range(max(0, i - g.window_size), i):
+                    w = 1.0 / (i - j)
+                    expect[(int(idx[i]), int(idx[j]))] += w
+                    if symmetric:
+                        expect[(int(idx[j]), int(idx[i]))] += w
+        assert set(got) == set(expect)
+        for k in expect:
+            assert got[k] == pytest.approx(expect[k], rel=1e-9)
