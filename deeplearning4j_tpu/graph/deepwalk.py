@@ -14,7 +14,8 @@ TPU-first redesign: the reference trains one (vertex, vertex) pair per
 (``iterators.generate_walks``), window pairs are extracted for the whole
 walk batch with numpy slicing, and updates run through the same batched
 XLA hierarchical-softmax scatter-add kernel the word2vec tier uses
-(``nlp.word2vec._hs_step``) — thousands of pairs per device dispatch.
+(``nlp.word2vec._hs_update`` inside a per-epoch scan) — thousands of
+pairs per chunk, one device dispatch per epoch.
 """
 
 from __future__ import annotations
@@ -23,13 +24,36 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..nlp.vocab import huffman_codes
-from ..nlp.word2vec import _hs_step
+from ..nlp.word2vec import _hs_update
 from .api import NoEdgeHandling
 from .graph import Graph
 from .iterators import RandomWalkIterator, generate_walks
+
+
+def _deepwalk_epoch(syn0, syn1, inputs, targets, pmask, points, codes,
+                    cmask, lr):
+    """One DeepWalk epoch as a single scan over (n_chunks, B) pair
+    arrays: the ``_hs_update`` math per chunk, Huffman path gathers on
+    device.  Same device-residency move as the word2vec corpus pipeline
+    (``nlp/device_corpus.py``) — DeepWalk's pairing rule is static, so
+    the host keeps only the shifted-slice pair extraction.  (jit
+    specializes per shape; no factory needed.)"""
+    def body(carry, xs):
+        syn0, syn1, loss_sum = carry
+        bi, bt, pm = xs
+        syn0, syn1, loss = _hs_update(syn0, syn1, bi, points[bt],
+                                      codes[bt], cmask[bt], pm, lr)
+        return (syn0, syn1, loss_sum + loss), None
+    (syn0, syn1, loss), _ = jax.lax.scan(
+        body, (syn0, syn1, jnp.float32(0.0)), (inputs, targets, pmask))
+    return syn0, syn1, loss
+
+
+_deepwalk_epoch = jax.jit(_deepwalk_epoch, donate_argnums=(0, 1))
 
 
 class GraphHuffman:
@@ -154,6 +178,10 @@ class DeepWalk(GraphVectors):
             self._points[v, :len(pts)] = pts
             self._codes[v, :len(cds)] = cds
             self._code_mask[v, :len(cds)] = 1.0
+        # device-resident Huffman tables for the epoch scan
+        self._points_dev = jnp.asarray(self._points)
+        self._codes_dev = jnp.asarray(self._codes)
+        self._cmask_dev = jnp.asarray(self._code_mask)
         self._init_called = True
 
     # -- training ----------------------------------------------------------
@@ -207,28 +235,40 @@ class DeepWalk(GraphVectors):
         return np.concatenate(ins), np.concatenate(tgts)
 
     def _train_walks(self, walks: np.ndarray) -> None:
+        """One epoch's pairs as ONE scan dispatch over device-resident
+        arrays.  The pair stream, chunk boundaries, mask padding, and
+        update math are identical to the former per-batch ``_hs_step``
+        loop (which paid a host dispatch plus three host-side
+        ``points[bt]`` gathers per 2048 pairs); the Huffman tables live
+        on device (uploaded at initialize) and the epoch ships only the
+        walks' (inputs, targets) index arrays."""
         inputs, targets = self._walk_pairs(walks)
         if inputs.size == 0:
             return
-        B = self.batch_size
-        lr = jnp.float32(self.learning_rate)
-        for s in range(0, inputs.size, B):
-            bi = inputs[s:s + B]
-            bt = targets[s:s + B]
-            pad = B - bi.size
-            pair_mask = np.ones(B, np.float32)
-            if pad:
-                pair_mask[bi.size:] = 0.0
-                bi = np.pad(bi, (0, pad))
-                bt = np.pad(bt, (0, pad))
-            self.syn0, self.syn1, loss = _hs_step(
-                self.syn0, self.syn1,
-                jnp.asarray(bi, jnp.int32),
-                jnp.asarray(self._points[bt]),
-                jnp.asarray(self._codes[bt]),
-                jnp.asarray(self._code_mask[bt]),
-                jnp.asarray(pair_mask), lr)
-            self._cum_loss += float(loss)
+        # Clamp pairs-per-update to ~2x the vertex count: a batched
+        # scatter applies every duplicate row's gradient at the same
+        # stale point (effective k x lr), which diverges once the batch
+        # dwarfs the vertex set (a 20-vertex graph at B=2048 blew up to
+        # 1e11 within 8 epochs) — the word2vec tier's
+        # ``_effective_batch`` rule, applied to vertices.
+        B = int(min(self.batch_size,
+                    max(64, 2 * self.syn0.shape[0])))
+        n = inputs.size
+        n_chunks = -(-n // B)
+        pad = n_chunks * B - n
+        pmask = np.ones(n_chunks * B, np.float32)
+        if pad:
+            pmask[n:] = 0.0
+            inputs = np.pad(inputs, (0, pad))
+            targets = np.pad(targets, (0, pad))
+        self.syn0, self.syn1, loss = _deepwalk_epoch(
+            self.syn0, self.syn1,
+            jnp.asarray(inputs.reshape(n_chunks, B).astype(np.int32)),
+            jnp.asarray(targets.reshape(n_chunks, B).astype(np.int32)),
+            jnp.asarray(pmask.reshape(n_chunks, B)),
+            self._points_dev, self._codes_dev, self._cmask_dev,
+            jnp.float32(self.learning_rate))
+        self._cum_loss += float(np.asarray(loss))
 
     # -- GraphVectors surface ---------------------------------------------
 

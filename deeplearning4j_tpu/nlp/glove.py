@@ -9,8 +9,6 @@ jitted XLA batches (the reference runs per-pair AdaGrad in Java threads).
 
 from __future__ import annotations
 
-import functools
-from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,33 +54,31 @@ def _glove_update(W: Array, Wc: Array, b: Array, bc: Array, hW: Array,
 _glove_step = jax.jit(_glove_update, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
 
 
-@functools.lru_cache(maxsize=8)
-def _glove_epoch_fn(n_chunks: int, batch: int):
+def _glove_epoch(W, Wc, b, bc, hW, hWc, hb, hbc, rows_all, cols_all,
+                 logx_all, fx_all, order, lr):
     """One EPOCH of AdaGrad as a single scan dispatch: the co-occurrence
     triples live on device (uploaded once per fit), and each epoch ships
     only the shuffled (n_chunks, B) permutation — the same
     device-residency move as ``nn/ingest.py``'s epoch cache and
     ``nlp/device_corpus.py``.  The update math, chunk boundaries, mask
     padding, and shuffle stream are IDENTICAL to the per-batch path
-    (parity-tested), so this is purely a dispatch-structure change."""
+    (parity-tested), so this is purely a dispatch-structure change.
+    (jit specializes per (n_chunks, B) shape; no factory needed.)"""
+    def body(carry, idx):
+        W, Wc, b, bc, hW, hWc, hb, hbc, loss_sum = carry
+        mask = (idx >= 0).astype(jnp.float32)
+        sel = jnp.maximum(idx, 0)
+        (W, Wc, b, bc, hW, hWc, hb, hbc, loss) = _glove_update(
+            W, Wc, b, bc, hW, hWc, hb, hbc, rows_all[sel],
+            cols_all[sel], logx_all[sel], fx_all[sel], mask, lr)
+        return (W, Wc, b, bc, hW, hWc, hb, hbc, loss_sum + loss), None
+    init = (W, Wc, b, bc, hW, hWc, hb, hbc, jnp.float32(0.0))
+    (W, Wc, b, bc, hW, hWc, hb, hbc, loss), _ = jax.lax.scan(
+        body, init, order)
+    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
 
-    def epoch(W, Wc, b, bc, hW, hWc, hb, hbc, rows_all, cols_all,
-              logx_all, fx_all, order, lr):
-        def body(carry, idx):
-            W, Wc, b, bc, hW, hWc, hb, hbc, loss_sum = carry
-            mask = (idx >= 0).astype(jnp.float32)
-            sel = jnp.maximum(idx, 0)
-            (W, Wc, b, bc, hW, hWc, hb, hbc, loss) = _glove_update(
-                W, Wc, b, bc, hW, hWc, hb, hbc, rows_all[sel],
-                cols_all[sel], logx_all[sel], fx_all[sel], mask, lr)
-            return (W, Wc, b, bc, hW, hWc, hb, hbc,
-                    loss_sum + loss), None
-        init = (W, Wc, b, bc, hW, hWc, hb, hbc, jnp.float32(0.0))
-        (W, Wc, b, bc, hW, hWc, hb, hbc, loss), _ = jax.lax.scan(
-            body, init, order)
-        return W, Wc, b, bc, hW, hWc, hb, hbc, loss
 
-    return jax.jit(epoch, donate_argnums=tuple(range(8)))
+_glove_epoch = jax.jit(_glove_epoch, donate_argnums=tuple(range(8)))
 
 
 class Glove(SequenceVectors):
@@ -92,6 +88,10 @@ class Glove(SequenceVectors):
     #: co-occurrence keys buffered between dedup flushes (bounds the
     #: counting pass's transient memory on huge corpora)
     COOC_CHUNK_KEYS = 4_000_000
+
+    #: final-epoch weighted-least-squares loss of the last fit (None
+    #: until a fit trains at least one epoch on a non-empty cooc set)
+    last_epoch_loss: Optional[float] = None
 
     def __init__(self, x_max: float = 100.0, alpha: float = 0.75,
                  symmetric: bool = True, **kwargs):
@@ -112,6 +112,18 @@ class Glove(SequenceVectors):
         as i*V + j and merge-summed with unique/bincount — the Python
         per-position double loop this replaces was the fit bottleneck
         past ~100k words (O(corpus x window) dict ops)."""
+        V = max(self.vocab.num_words(), 1)
+        uk, sums = self._cooc_arrays(seqs)
+        return {(int(k // V), int(k % V)): float(s)
+                for k, s in zip(uk, sums)}
+
+    def _cooc_arrays(self, seqs: List[List[str]]
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, sums) arrays of the windowed co-occurrence counts —
+        keys are i*V + j.  ``fit`` consumes these directly (the dict
+        form above exists for the reference-shaped API and tests; a
+        4.5M-triple corpus spent more time building/flattening the dict
+        than counting)."""
         V = max(self.vocab.num_words(), 1)
         deduped: List[Tuple[np.ndarray, np.ndarray]] = []
         keys_parts: List[np.ndarray] = []
@@ -149,24 +161,25 @@ class Glove(SequenceVectors):
                 flush()
         flush()
         if not deduped:
-            return {}
+            return (np.zeros(0, np.int64), np.zeros(0, np.float64))
         keys = np.concatenate([k for k, _ in deduped])
         uk, inv = np.unique(keys, return_inverse=True)
         sums = np.bincount(
             inv, weights=np.concatenate([s for _, s in deduped]))
-        return {(int(k // V), int(k % V)): float(s)
-                for k, s in zip(uk, sums)}
+        return uk, sums
 
     # ------------------------------------------------------------- training
     def fit(self, sequences) -> "Glove":
         seq_list = [list(s) for s in sequences]
         if self.vocab is None:
             self.build_vocab(seq_list)
-        counts = self._count_cooccurrences(seq_list)
-        if not counts:
+        V = max(self.vocab.num_words(), 1)
+        keys, sums = self._cooc_arrays(seq_list)
+        if keys.size == 0:
             return self
-        pairs = np.array(list(counts.keys()), np.int32)
-        xs = np.array(list(counts.values()), np.float32)
+        pairs = np.stack([(keys // V).astype(np.int32),
+                          (keys % V).astype(np.int32)], axis=1)
+        xs = sums.astype(np.float32)
         logx = np.log(xs)
         fx = np.minimum(1.0, (xs / self.x_max) ** self.alpha).astype(
             np.float32)
@@ -189,19 +202,19 @@ class Glove(SequenceVectors):
         B = self.batch_size
         n = pairs.shape[0]
         n_chunks = -(-n // B)
+        del keys, sums
         # triples device-resident for the whole fit; each epoch ships one
         # shuffled permutation and runs as ONE scan dispatch
         rows_d = jnp.asarray(pairs[:, 0])
         cols_d = jnp.asarray(pairs[:, 1])
         logx_d = jnp.asarray(logx)
         fx_d = jnp.asarray(fx)
-        epoch_fn = _glove_epoch_fn(n_chunks, B)
         order = np.arange(n)
         for _ in range(self.epochs):
             self._rng.shuffle(order)
             padded = np.full(n_chunks * B, -1, np.int32)
             padded[:n] = order
-            (W, Wc, b, bc, hW, hWc, hb, hbc, ep_loss) = epoch_fn(
+            (W, Wc, b, bc, hW, hWc, hb, hbc, ep_loss) = _glove_epoch(
                 W, Wc, b, bc, hW, hWc, hb, hbc, rows_d, cols_d, logx_d,
                 fx_d, jnp.asarray(padded.reshape(n_chunks, B)), lr)
         #: monitored loss: the FINAL epoch's weighted-least-squares sum

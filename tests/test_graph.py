@@ -254,3 +254,39 @@ class TestSerializer:
                                    dw.vertex_vectors(), rtol=1e-6)
         assert loaded.num_vertices() == 6
         assert loaded.vector_size == 5
+
+
+def test_deepwalk_stable_on_tiny_graph_many_epochs():
+    """Pairs-per-update must clamp to ~2x vertices: un-clamped batched
+    scatters apply every duplicate row's gradient at a stale point
+    (effective k x lr) and a 20-vertex graph at batch 2048 diverged to
+    1e11 within 8 epochs.  Long training must stay finite and learn the
+    two-clique structure."""
+    import numpy as np
+    from deeplearning4j_tpu.graph.graph import Graph
+    from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+
+    rng = np.random.RandomState(3)
+    g = Graph(20)
+    for c in (0, 10):
+        for i in range(10):
+            for j in range(i + 1, 10):
+                if rng.rand() < 0.7:
+                    g.add_edge(c + i, c + j)
+    g.add_edge(0, 10)
+    dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+          .learning_rate(0.05).seed(1).build())
+    dw.initialize(g)
+    for _ in range(20):
+        dw.fit(g, walk_length=30)
+    s0 = np.asarray(dw.syn0)
+    assert np.isfinite(s0).all()
+    assert np.abs(s0).max() < 50.0         # bounded, not exploding
+
+    def sim(a, b):
+        va, vb = s0[a], s0[b]
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb)))
+    within = np.mean([sim(1, i) for i in range(2, 8)])
+    across = np.mean([sim(1, 10 + i) for i in range(2, 8)])
+    assert within > across
