@@ -361,7 +361,87 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
     return jax.jit(epoch, donate_argnums=(0, 1, 2))
 
 
-class DeviceSkipGram:
+def _trainer_tables(sv):
+    """Device-resident sampling/Huffman tables shared by every corpus
+    trainer (skip-gram/CBOW spans, PV-DBOW label pairs): subsample
+    keep-probs, the block negative table, HS path arrays — with the
+    same disabled-placeholder shapes everywhere."""
+    keep = keep_probabilities(sv.vocab, sv.sampling)
+    keep_prob = (jnp.asarray(keep) if keep is not None
+                 else jnp.ones((1,), jnp.float32))
+    if sv.negative > 0:
+        neg_table = jnp.asarray(block_negative_table(
+            sv.lookup_table.negative_table(), int(sv.negative), sv.seed))
+    else:
+        neg_table = jnp.zeros((1, 1), jnp.int32)
+    if sv.use_hs:
+        hs_points, hs_codes, hs_cmask = sv._code_arrays
+    else:
+        hs_points = jnp.zeros((1, 1), jnp.int32)
+        hs_codes = jnp.zeros((1, 1))
+        hs_cmask = jnp.zeros((1, 1))
+    return keep_prob, neg_table, hs_points, hs_codes, hs_cmask
+
+
+class _TrainerCounters:
+    """Lazy pass counters + lifetime RNG shared by the device trainers:
+    keys derive from the LIFETIME pass count so a cached pipeline
+    re-fit never replays the first fit's draws; counters fetch (the
+    device barrier) only in finish() so passes dispatch back-to-back
+    and totals accumulate across fits."""
+
+    def __init__(self):
+        self.pairs_trained = 0.0
+        self.loss_sum = 0.0
+        self._pending: List = []
+        self._passes_run = 0
+
+    def _next_key(self, seed: int):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 self._passes_run)
+        self._passes_run += 1
+        return key
+
+    def finish(self) -> Tuple[float, float]:
+        for pairs, loss in self._pending:
+            self.pairs_trained += float(np.asarray(pairs))
+            self.loss_sum += float(np.asarray(loss))
+        self._pending = []
+        return self.pairs_trained, self.loss_sum
+
+
+def build_interleaved_label_arrays(seqs: List[np.ndarray],
+                                   label_rows: List[int], chunk: int):
+    """(corpus, pos_label, n) for the label-pair trainer, with document
+    positions INTERLEAVED round-robin (doc0[0], doc1[0], ..., doc0[1],
+    ...).  Contiguous layout would put one document's every position —
+    all scattering into the SAME label row — inside one update chunk: a
+    2000-word document at chunk 2048 is an effective ~2000x lr on its
+    label (the duplicate-scatter divergence mechanism).  Interleaving
+    bounds label duplicates per chunk at ~ceil(chunk / n_docs)."""
+    lengths = np.array([s.size for s in seqs], np.int64)
+    n = int(lengths.sum())
+    if n == 0:
+        corpus = np.zeros(chunk, np.int32)
+        return corpus, np.full(chunk, -1, np.int32), 0
+    # stable sort by depth-in-document == round-robin over documents,
+    # O(n log n) time and O(n) memory (a dense (n_docs, max_len) matrix
+    # is O(n_docs x longest_doc) — 40 GB for 100k short docs + one 50k-
+    # word document)
+    depth = np.concatenate([np.arange(sz) for sz in lengths])
+    order = np.argsort(depth, kind="stable")
+    flat_w = np.concatenate(seqs)[order]
+    flat_l = np.repeat(np.asarray(label_rows, np.int64), lengths)[order]
+    npad = max(chunk, ((n + chunk - 1) // chunk) * chunk)
+    corpus = np.zeros(npad, np.int32)
+    pos_label = np.full(npad, -1, np.int32)
+    corpus[:n] = flat_w
+    pos_label[:n] = flat_l
+    return corpus, pos_label, n
+
+
+
+class DeviceSkipGram(_TrainerCounters):
     """Device-resident corpus pipeline bound to a ``SequenceVectors``
     instance (skip-gram and CBOW element-learning algorithms)."""
 
@@ -387,28 +467,12 @@ class DeviceSkipGram:
         self.n_spans = self.npad // self.span
         self.corpus = jnp.asarray(corpus)
         self.sent = jnp.asarray(sent)
-        keep = keep_probabilities(sv.vocab, sv.sampling)
-        self.keep_prob = (jnp.asarray(keep) if keep is not None
-                          else jnp.ones((1,), jnp.float32))
-        if sv.negative > 0:
-            self.neg_table = jnp.asarray(block_negative_table(
-                sv.lookup_table.negative_table(), int(sv.negative),
-                sv.seed))
-        else:
-            self.neg_table = jnp.zeros((1, 1), jnp.int32)
-        if sv.use_hs:
-            self.hs_points, self.hs_codes, self.hs_cmask = sv._code_arrays
-        else:
-            z = jnp.zeros((1, 1))
-            self.hs_points = jnp.zeros((1, 1), jnp.int32)
-            self.hs_codes, self.hs_cmask = z, z
+        (self.keep_prob, self.neg_table, self.hs_points, self.hs_codes,
+         self.hs_cmask) = _trainer_tables(sv)
         self._fn = _epoch_fn(W, int(sv.negative), sv.use_hs, self.span,
                              self.n_spans, sv.sampling > 0, self.npad,
                              sv.algorithm)
-        self.pairs_trained = 0.0
-        self.loss_sum = 0.0
-        self._pending = []      # per-pass lazy (pairs, loss) device scalars
-        self._passes_run = 0    # lifetime counter: fresh RNG every pass
+        _TrainerCounters.__init__(self)
 
     def run_pass(self, pass_idx: int, total_words: int) -> None:
         """One full corpus pass (epoch x iteration): compute the span
@@ -420,12 +484,7 @@ class DeviceSkipGram:
         alphas = np.maximum(
             sv.min_learning_rate,
             sv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
-        # Key off the LIFETIME pass count, not pass_idx: a cached pipe
-        # re-fit with pass_idx restarting at 0 would otherwise replay
-        # the exact same subsampling/shrink/negative draws every fit.
-        key = jax.random.fold_in(jax.random.PRNGKey(sv.seed),
-                                 self._passes_run)
-        self._passes_run += 1
+        key = self._next_key(sv.seed)
         lt = sv.lookup_table
         syn1 = lt.syn1 if sv.use_hs else jnp.zeros((1, 1), jnp.float32)
         syn1neg = (lt.syn1neg if sv.negative > 0
@@ -442,13 +501,117 @@ class DeviceSkipGram:
             lt.syn1neg = syn1neg
         self._pending.append((pairs, loss))
 
-    def finish(self) -> Tuple[float, float]:
-        """Fetch and sum every pending pass's counters (the
-        device->host barrier; counters stay lazy until here so passes
-        dispatch back-to-back).  Totals accumulate across run_pass calls
-        since construction — 'pairs_trained' means ALL passes."""
-        for pairs, loss in self._pending:
-            self.pairs_trained += float(np.asarray(pairs))
-            self.loss_sum += float(np.asarray(loss))
-        self._pending = []
-        return self.pairs_trained, self.loss_sum
+
+@functools.lru_cache(maxsize=8)
+def _labelpair_epoch_fn(negative: int, use_hs: bool, chunk: int,
+                        n_chunks: int, subsample: bool):
+    """PV-DBOW label->word training as one scan per corpus pass: each
+    position contributes ONE (document label, word) pair (reference
+    ``DBOW.java`` — no windowing), so the pipeline is the word2vec
+    corpus scan minus the grid: per-position subsample draw, LCG
+    negatives, and the shared HS/NS update math with the label row as
+    the input vector."""
+    K = negative
+    labels_vec = jnp.asarray(np.concatenate(
+        [[1.0], np.zeros(K)]).astype(np.float32)) if K > 0 else None
+
+    def epoch(syn0, syn1, syn1neg, corpus, pos_label, keep_prob,
+              neg_table, hs_points, hs_codes, hs_cmask, alphas, key):
+        span_keys = jax.random.split(key, n_chunks)
+
+        def body(carry, xs):
+            syn0, syn1, syn1neg, pair_count, loss_sum = carry
+            c, alpha, ckey = xs
+            words = jax.lax.dynamic_slice(corpus, (c * chunk,), (chunk,))
+            labs = jax.lax.dynamic_slice(pos_label, (c * chunk,),
+                                         (chunk,))
+            pm = (labs >= 0).astype(jnp.float32)   # -1 pads/OOV docs
+            if subsample:
+                kb, kn = jax.random.split(ckey)
+                r = jax.random.uniform(kb, (chunk,))
+                pm = pm * (r < keep_prob[words]).astype(jnp.float32)
+            else:
+                kn = ckey
+            inputs = jnp.maximum(labs, 0)
+            loss = jnp.float32(0.0)
+            if use_hs:
+                syn0, syn1, l_hs = _hs_update(
+                    syn0, syn1, inputs, hs_points[words],
+                    hs_codes[words], hs_cmask[words], pm, alpha)
+                loss = loss + l_hs
+            if K > 0:
+                seed = jax.random.bits(kn, (), jnp.uint32)
+                negs = lcg_negatives(seed, chunk, K, neg_table)
+                tgt = jnp.concatenate([words[:, None], negs], axis=1)
+                tmask = jnp.concatenate(
+                    [jnp.ones((chunk, 1), jnp.float32),
+                     (negs != words[:, None]).astype(jnp.float32)],
+                    axis=1)
+                syn0, syn1neg, l_ns = _ns_update(
+                    syn0, syn1neg, inputs, tgt, labels_vec, tmask, pm,
+                    alpha)
+                loss = loss + l_ns
+            return (syn0, syn1, syn1neg, pair_count + jnp.sum(pm),
+                    loss_sum + loss), None
+
+        init = (syn0, syn1, syn1neg, jnp.float32(0.0), jnp.float32(0.0))
+        xs = (jnp.arange(n_chunks), alphas, span_keys)
+        (syn0, syn1, syn1neg, pairs, loss), _ = jax.lax.scan(
+            body, init, xs)
+        return syn0, syn1, syn1neg, pairs, loss
+
+    return jax.jit(epoch, donate_argnums=(0, 1, 2))
+
+
+class DeviceDbowLabels(_TrainerCounters):
+    """Device-resident PV-DBOW label-pair trainer bound to a
+    ``ParagraphVectors`` instance: corpus words + per-position label
+    rows upload once (document positions interleaved — see
+    :func:`build_interleaved_label_arrays`); each pass is one scan
+    dispatch.  Chunk size additionally clamps to ~4x the document
+    count so label-row duplicates per update stay ~4; corpora with a
+    handful of documents bottom out at chunk 8 (duplicates <= 8 — the
+    interleave cannot help a single-document corpus, where every
+    position shares one label row)."""
+
+    def __init__(self, pv, seqs: List[np.ndarray],
+                 label_rows: List[int]):
+        _TrainerCounters.__init__(self)
+        self.pv = pv
+        eff = max(64, pv._effective_batch())
+        self.chunk = int(min(eff, max(8, 4 * len(seqs))))
+        corpus, pos_label, n = build_interleaved_label_arrays(
+            seqs, label_rows, self.chunk)
+        self.n_words = n
+        self.n_chunks = corpus.shape[0] // self.chunk
+        self.corpus = jnp.asarray(corpus)
+        self.pos_label = jnp.asarray(pos_label)
+        (self.keep_prob, self.neg_table, self.hs_points, self.hs_codes,
+         self.hs_cmask) = _trainer_tables(pv)
+        self._fn = _labelpair_epoch_fn(int(pv.negative), pv.use_hs,
+                                       self.chunk, self.n_chunks,
+                                       pv.sampling > 0)
+
+    def run_pass(self, pass_idx: int, total_words: int) -> None:
+        pv = self.pv
+        seen0 = pass_idx * self.n_words
+        starts = seen0 + np.arange(self.n_chunks) * self.chunk
+        alphas = np.maximum(
+            pv.min_learning_rate,
+            pv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
+        key = self._next_key(pv.seed + 7919)
+        lt = pv.lookup_table
+        syn1 = lt.syn1 if pv.use_hs else jnp.zeros((1, 1), jnp.float32)
+        syn1neg = (lt.syn1neg if pv.negative > 0
+                   else jnp.zeros((1, 1), jnp.float32))
+        syn0, syn1, syn1neg, pairs, loss = self._fn(
+            lt.syn0, syn1, syn1neg, self.corpus, self.pos_label,
+            self.keep_prob, self.neg_table, self.hs_points,
+            self.hs_codes, self.hs_cmask,
+            jnp.asarray(alphas.astype(np.float32)), key)
+        lt.syn0 = syn0
+        if pv.use_hs:
+            lt.syn1 = syn1
+        if pv.negative > 0:
+            lt.syn1neg = syn1neg
+        self._pending.append((pairs, loss))

@@ -85,12 +85,69 @@ class ParagraphVectors(SequenceVectors):
         self._prepare_code_arrays()
 
     # ------------------------------------------------------------- training
+    def _device_eligible_dbow(self, docs) -> bool:
+        """Route PV-DBOW onto the device pipelines: the word-vector side
+        reuses the skip-gram corpus scan, the label side the label-pair
+        scan.  Same gating posture as ``SequenceVectors._device_eligible``
+        plus: DBOW only (DM keeps the host CBOW+label loop), and
+        subclasses overriding ``_train_document`` keep their loop."""
+        if self.sequence_algorithm != "dbow":
+            return False
+        if type(self)._train_document is not ParagraphVectors._train_document:
+            return False
+        return self._device_eligible([t for t, _ in docs])
+
+    def _fit_device_dbow(self, docs, source=None) -> "ParagraphVectors":
+        """Both device pipelines (word side + label side), with the
+        corpus indexed ONCE and both built pipelines cached across
+        fit() calls keyed on the caller's ``documents`` object, the
+        vocab, and the baked config (the SequenceVectors cache posture:
+        re-fitting for more epochs skips re-indexing/re-upload and
+        draws fresh RNG via the pipelines' lifetime pass counters)."""
+        from .device_corpus import DeviceDbowLabels
+        conf_key = self._device_conf_key() + (self.train_word_vectors,)
+        cached = getattr(self, "_device_dbow_cache", None)
+        if not (cached is not None and source is not None
+                and cached[0] is source and cached[1] is self.vocab
+                and cached[2] == conf_key):
+            cached = None
+        seqs = None
+        if cached is None:
+            seqs = [self._sequence_to_indices(t) for t, _ in docs]
+        if self.train_word_vectors:
+            # word-vector side: the standard skip-gram pipeline with its
+            # own source-keyed cache (shares the index arrays on a cold
+            # build; on a warm re-fit neither side re-indexes)
+            self._fit_device([t for t, _ in docs], source=source,
+                             seqs_idx=seqs)
+        if cached is not None:
+            label_pipe = cached[3]
+        else:
+            labels = [self.vocab.index_of(lab) for _, lab in docs]
+            keep = [(s, l) for s, l in zip(seqs, labels)
+                    if s.size >= 1 and l >= 0]
+            if not keep:
+                # zeroed stats: stale numbers from a prior fit must not
+                # read as this fit having trained labels
+                self._device_dbow_stats = {"pairs_trained": 0.0,
+                                           "loss_sum": 0.0, "passes": 0}
+                return self
+            label_pipe = DeviceDbowLabels(self, [s for s, _ in keep],
+                                          [l for _, l in keep])
+            if source is not None:
+                self._device_dbow_cache = (source, self.vocab, conf_key,
+                                           label_pipe)
+        self._device_dbow_stats = self._run_device_passes(label_pipe)
+        return self
+
     def fit(self, documents=None) -> "ParagraphVectors":
         docs = self._resolve_documents(documents)
         self._docs = docs
         if self.vocab is None:
             self.build_vocab_from_documents(docs)
         self._reset_queues()  # drop stale pairs from an aborted prior fit
+        if self._device_eligible_dbow(docs):
+            return self._fit_device_dbow(docs, source=documents)
         total = sum(len(t) for t, _ in docs) * self.epochs * self.iterations
         seen = 0
         for _ in range(self.epochs):

@@ -320,7 +320,21 @@ class SequenceVectors:
                 self.sampling, self.batch_size, self.seed,
                 self.pair_generation, self.algorithm)
 
-    def _fit_device(self, seq_list, source=None) -> "SequenceVectors":
+    def _run_device_passes(self, pipe) -> Dict:
+        """Run epochs x iterations passes on a device pipeline and
+        return THIS fit's stats (deltas — the pipeline's counters span
+        its cached lifetime)."""
+        passes = self.epochs * self.iterations
+        total_words = pipe.n_words * passes
+        prev_pairs, prev_loss = pipe.pairs_trained, pipe.loss_sum
+        for p in range(passes):
+            pipe.run_pass(p, total_words)
+        pipe.finish()
+        return {"pairs_trained": pipe.pairs_trained - prev_pairs,
+                "loss_sum": pipe.loss_sum - prev_loss, "passes": passes}
+
+    def _fit_device(self, seq_list, source=None,
+                    seqs_idx=None) -> "SequenceVectors":
         """On-device corpus pipeline: one scan dispatch per corpus pass
         (see ``nlp/device_corpus.py``).
 
@@ -339,7 +353,8 @@ class SequenceVectors:
                 and cached[2] == conf_key):
             pipe = cached[3]
         else:
-            seqs = [self._sequence_to_indices(s) for s in seq_list]
+            seqs = (seqs_idx if seqs_idx is not None else
+                    [self._sequence_to_indices(s) for s in seq_list])
             seqs = [s for s in seqs if s.size >= 2]
             if not seqs:
                 return self
@@ -347,19 +362,9 @@ class SequenceVectors:
             if source is not None:
                 self._device_fit_cache = (source, self.vocab, conf_key,
                                           pipe)
-        passes = self.epochs * self.iterations
-        total_words = pipe.n_words * passes
-        prev_pairs, prev_loss = pipe.pairs_trained, pipe.loss_sum
-        for p in range(passes):
-            pipe.run_pass(p, total_words)
-        pipe.finish()
-        # Deltas: the cached pipe's counters span its whole lifetime;
-        # the stats contract is THIS fit (all of its passes).
-        self._device_pipeline_stats = {
-            "pairs_trained": pipe.pairs_trained - prev_pairs,
-            "loss_sum": pipe.loss_sum - prev_loss,
-            "passes": passes, "span": pipe.span,
-            "n_spans": pipe.n_spans}
+        stats = self._run_device_passes(pipe)
+        stats.update(span=pipe.span, n_spans=pipe.n_spans)
+        self._device_pipeline_stats = stats
         return self
 
     def fit(self, sequences) -> "SequenceVectors":

@@ -296,3 +296,121 @@ def test_cbow_host_and_device_agree_on_quality():
         inter = np.mean([sv.similarity("a1", "b%d" % i)
                          for i in range(2, 8)])
         assert intra > inter + 0.15, (pg, intra, inter)
+
+
+def _doc_corpus(rng, n_docs=120, length=20):
+    docs = []
+    for i in range(n_docs):
+        topic = i % 2
+        docs.append(" ".join(
+            ("sci" if topic == 0 else "art") + str(rng.randint(12))
+            for _ in range(length)))
+    return docs
+
+
+def _label_sims(pv, n=20):
+    def lv(i):
+        return pv.label_vector("DOC_%d" % i)
+
+    def sim(a, b):
+        va, vb = lv(a), lv(b)
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+    same = np.mean([sim(0, i) for i in range(2, n, 2)])
+    diff = np.mean([sim(0, i) for i in range(1, n, 2)])
+    return same, diff
+
+
+@pytest.mark.parametrize("hs,neg", [(True, 0.0), (False, 5.0)])
+def test_pv_dbow_device_learns_doc_topics(hs, neg):
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(6)
+    docs = _doc_corpus(rng)
+    pv = ParagraphVectors(layer_size=24, window_size=3, epochs=4,
+                          negative=neg, use_hierarchic_softmax=hs,
+                          min_word_frequency=1, pair_generation="device")
+    pv.fit(docs)
+    assert pv._device_dbow_stats["pairs_trained"] > 0
+    same, diff = _label_sims(pv)
+    assert same > diff
+    assert pv.predict(docs[0]) is not None
+
+
+def test_pv_dbow_host_and_device_agree_on_quality():
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(8)
+    docs = _doc_corpus(rng)
+    for pg in ("host", "device"):
+        pv = ParagraphVectors(layer_size=24, window_size=3, epochs=4,
+                              negative=5.0, use_hierarchic_softmax=False,
+                              min_word_frequency=1, pair_generation=pg)
+        pv.fit(docs)
+        same, diff = _label_sims(pv)
+        assert same > diff, (pg, same, diff)
+
+
+def test_pv_routing_gates():
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    docs = [(["a", "b"], "DOC_0")]
+    dm = ParagraphVectors(sequence_learning_algorithm="dm",
+                          pair_generation="device", layer_size=8)
+    assert not dm._device_eligible_dbow(docs)   # DM keeps host loop
+
+    class Custom(ParagraphVectors):
+        def _train_document(self, tokens, label, alpha):
+            return super()._train_document(tokens, label, alpha)
+
+    c = Custom(pair_generation="device", layer_size=8)
+    assert not c._device_eligible_dbow(docs)    # overridden hook -> host
+    d = ParagraphVectors(pair_generation="device", layer_size=8)
+    assert d._device_eligible_dbow(docs)
+
+
+def test_pv_dbow_cached_refit_trains_both_sides_fresh_rng():
+    """Repeat fit() on the same documents must hit both pipeline caches
+    (no re-index/re-upload), train BOTH sides again, and draw fresh RNG
+    (with subsampling on, identical draws would repeat the exact pair
+    count)."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(9)
+    docs = _doc_corpus(rng)
+    pv = ParagraphVectors(layer_size=16, window_size=3, epochs=1,
+                          negative=5.0, use_hierarchic_softmax=False,
+                          sampling=1e-3, min_word_frequency=1,
+                          pair_generation="device")
+    pv.fit(docs)
+    first_label = pv._device_dbow_stats["pairs_trained"]
+    first_word = pv._device_pipeline_stats["pairs_trained"]
+    w0 = pv.word_vector("sci1").copy()
+    pv.fit(docs)   # cached pipes
+    second_label = pv._device_dbow_stats["pairs_trained"]
+    second_word = pv._device_pipeline_stats["pairs_trained"]
+    assert first_label != second_label          # fresh subsample draws
+    assert second_word > 0                      # word side trained again
+    assert not np.allclose(w0, pv.word_vector("sci1"))
+
+
+def test_interleaved_label_arrays_bound_duplicates():
+    from deeplearning4j_tpu.nlp.device_corpus import (
+        build_interleaved_label_arrays)
+    # 8 docs of uneven lengths; chunk 16 -> per-chunk label duplicates
+    # should stay near ceil(16/8)=2, never a whole doc's length
+    rng = np.random.RandomState(10)
+    seqs = [rng.randint(0, 50, size=n).astype(np.int64)
+            for n in (40, 35, 3, 28, 40, 17, 9, 40)]
+    corpus, pos_label, n = build_interleaved_label_arrays(
+        seqs, list(range(8)), chunk=16)
+    assert n == sum(s.size for s in seqs)
+    # all words present with their own label
+    for d, s in enumerate(seqs):
+        got = np.sort(corpus[:n][pos_label[:n] == d])
+        np.testing.assert_array_equal(got, np.sort(s))
+    # duplicate bound per chunk: ceil(chunk / docs-still-live) — in the
+    # deepest tail only the 3 length-40 docs survive, so <= ceil(16/3)+1;
+    # the point is it NEVER approaches a contiguous layout's 16 (a whole
+    # chunk from one doc)
+    for c in range(n // 16):
+        labs = pos_label[c * 16:(c + 1) * 16]
+        labs = labs[labs >= 0]
+        if labs.size:
+            assert np.bincount(labs).max() <= 7
