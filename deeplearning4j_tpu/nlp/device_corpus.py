@@ -170,16 +170,29 @@ def pad_with_sentinels(corpus: Array, sent: Array, window: int):
             jnp.concatenate([zs, sent, zs]))
 
 
+def compact_dest(keep: Array, npad: int) -> Array:
+    """Scatter destinations for stable keep-compaction: kept position i
+    goes to cumsum(keep)[i]-1, dropped positions to the out-of-range
+    sentinel (mode='drop' discards them).  The ONE source of truth for
+    the compaction scheme — corpus, sentence ids, and any aligned
+    side-array (PV-DM's per-position labels) must all compact with the
+    SAME dest or positions and labels drift apart."""
+    tgt = jnp.cumsum(keep) - 1
+    return jnp.where(keep, tgt, npad)
+
+
+def compact_with(arr: Array, dest: Array, fill) -> Array:
+    """Apply :func:`compact_dest` destinations to one aligned array."""
+    return jnp.full_like(arr, fill).at[dest].set(arr, mode="drop")
+
+
 def subsample_compact(corpus: Array, sent: Array, keep: Array):
     """Compact (corpus, sent) down to the kept positions (windows close
     up over removed words — word2vec.c subsampling semantics); dropped
     tail gets sentinel sent_id -1.  Returns (corpus, sent, n_valid)."""
-    npad = corpus.shape[0]
-    tgt = jnp.cumsum(keep) - 1
-    dest = jnp.where(keep, tgt, npad)
-    corpus = jnp.zeros_like(corpus).at[dest].set(corpus, mode="drop")
-    sent = jnp.full_like(sent, -1).at[dest].set(sent, mode="drop")
-    return corpus, sent, jnp.sum(keep)
+    dest = compact_dest(keep, corpus.shape[0])
+    return (compact_with(corpus, dest, 0), compact_with(sent, dest, -1),
+            jnp.sum(keep))
 
 
 def block_negative_table(table: np.ndarray, k: int,
@@ -219,10 +232,11 @@ def lcg_negatives(seed: Array, rows: int, k: int, table_2d: Array):
     return table_2d[base]
 
 
-@functools.lru_cache(maxsize=8)
+@functools.lru_cache(maxsize=16)
 def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
-              n_spans: int, subsample: bool, npad: int,
-              algorithm: str = "skipgram"):
+              seg_spans: int, total_spans: int, subsample: bool,
+              npad: int, algorithm: str = "skipgram",
+              has_labels: bool = False):
     """Build + jit the one-pass scan.  All shape-determining config is
     in the cache key; arrays are traced arguments.
 
@@ -244,17 +258,25 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
     stale-read-within-batch compromise every batched scatter update in
     this module already makes)."""
     K = negative
+    if has_labels and algorithm != "cbow":
+        raise ValueError("label columns require the cbow branch (PV-DM)")
 
-    def epoch(syn0, syn1, syn1neg, corpus, sent, n_words, keep_prob,
-              neg_table, hs_points, hs_codes, hs_cmask, alphas, key):
+    def epoch(syn0, syn1, syn1neg, corpus, sent, pos_label, n_words,
+              keep_prob, neg_table, hs_points, hs_codes, hs_cmask,
+              alphas, key, span_offset):
         if subsample:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, corpus.shape)
             live = jnp.arange(npad) < n_words
             keep = (r < keep_prob[corpus]) & live
             corpus, sent, _ = subsample_compact(corpus, sent, keep)
+            if has_labels:
+                # labels compact with the SAME dest so each kept
+                # position keeps its document's label row
+                pos_label = compact_with(
+                    pos_label, compact_dest(keep, npad), -1)
         corpus_pad, sent_pad = pad_with_sentinels(corpus, sent, window)
-        span_keys = jax.random.split(key, n_spans)
+        span_keys = jax.random.split(key, seg_spans)
 
         cbow = algorithm == "cbow"
 
@@ -265,7 +287,24 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
             shrink = jax.random.randint(kb, (span,), 0, window)
             words, centers, pmask = pair_grid_shaped(
                 corpus_pad, sent_pad, c * span, shrink, window, span)
-            hc = syn0[words]                       # (b, 2W, d)
+            if has_labels:
+                # PV-DM: the document label joins every window as one
+                # extra always-live column (reference DM.java — label
+                # appended to the context; a center whose window is
+                # otherwise empty still trains from the label alone,
+                # the host path's single-word-document fallback)
+                labs = jax.lax.dynamic_slice(pos_label, (c * span,),
+                                             (span,))
+                words = jnp.concatenate(
+                    [words, jnp.maximum(labs, 0)[:, None]], axis=1)
+                pmask = jnp.concatenate(
+                    [pmask,
+                     (labs >= 0).astype(jnp.float32)[:, None]], axis=1)
+            # segment overrun guard: a ragged final segment's extra span
+            # indices get start-clamped slices of REAL tail data; the
+            # validity mask turns them into no-ops
+            pmask = pmask * (c < total_spans).astype(jnp.float32)
+            hc = syn0[words]                       # (b, 2W[+1], d)
             if cbow:
                 # CBOW: ONE example per center — h is the masked MEAN
                 # of the window's vectors; the input-side gradient dh
@@ -353,7 +392,7 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     loss_sum + loss), None
 
         init = (syn0, syn1, syn1neg, jnp.float32(0.0), jnp.float32(0.0))
-        xs = (jnp.arange(n_spans), alphas, span_keys)
+        xs = (span_offset + jnp.arange(seg_spans), alphas, span_keys)
         (syn0, syn1, syn1neg, pairs, loss), _ = jax.lax.scan(
             body, init, xs)
         return syn0, syn1, syn1neg, pairs, loss
@@ -443,10 +482,17 @@ def build_interleaved_label_arrays(seqs: List[np.ndarray],
 
 class DeviceSkipGram(_TrainerCounters):
     """Device-resident corpus pipeline bound to a ``SequenceVectors``
-    instance (skip-gram and CBOW element-learning algorithms)."""
+    instance (skip-gram and CBOW element-learning algorithms; with
+    ``label_rows`` the CBOW branch becomes PV-DM — each document's
+    label row joins every window as an extra always-live column)."""
 
-    def __init__(self, sv, seqs: List[np.ndarray]):
+    def __init__(self, sv, seqs: List[np.ndarray],
+                 label_rows: Optional[List[int]] = None,
+                 algorithm: Optional[str] = None):
         self.sv = sv
+        self.algorithm = algorithm or sv.algorithm
+        if label_rows is not None and self.algorithm != "cbow":
+            raise ValueError("label_rows requires the cbow branch")
         W = sv.window_size
         # Span sized so EXPECTED live examples per update step track
         # the host path's divergence clamp (``_effective_batch``).
@@ -456,7 +502,7 @@ class DeviceSkipGram(_TrainerCounters):
         # stabilized for (sentence boundaries only lower occupancy).
         # CBOW trains ONE example per center, so span = eff directly.
         eff = max(64, sv._effective_batch())
-        if sv.algorithm == "cbow":
+        if self.algorithm == "cbow":
             self.span = max(16, eff)
         else:
             live_frac = (W + 1) / (2 * W)
@@ -467,20 +513,50 @@ class DeviceSkipGram(_TrainerCounters):
         self.n_spans = self.npad // self.span
         self.corpus = jnp.asarray(corpus)
         self.sent = jnp.asarray(sent)
+        if label_rows is not None:
+            # per-position label row, aligned with build_corpus_arrays'
+            # contiguous layout (padding/tail stay -1 via the sent ids)
+            pos_label = np.where(
+                sent >= 0,
+                np.asarray(label_rows + [0], np.int32)[
+                    np.maximum(sent, 0)],
+                np.int32(-1))
+            self.pos_label = jnp.asarray(pos_label.astype(np.int32))
+        else:
+            self.pos_label = jnp.zeros((self.npad,), jnp.int32)
         (self.keep_prob, self.neg_table, self.hs_points, self.hs_codes,
          self.hs_cmask) = _trainer_tables(sv)
-        self._fn = _epoch_fn(W, int(sv.negative), sv.use_hs, self.span,
-                             self.n_spans, sv.sampling > 0, self.npad,
-                             sv.algorithm)
+        self._has_labels = label_rows is not None
         _TrainerCounters.__init__(self)
 
-    def run_pass(self, pass_idx: int, total_words: int) -> None:
-        """One full corpus pass (epoch x iteration): compute the span
-        lr schedule on host, dispatch the scan, keep counters as lazy
-        device scalars (fetch = completion barrier, done in finish())."""
+    def _seg_fn(self, seg_spans: int):
         sv = self.sv
+        return _epoch_fn(sv.window_size, int(sv.negative), sv.use_hs,
+                         self.span, seg_spans, self.n_spans,
+                         sv.sampling > 0, self.npad, self.algorithm,
+                         self._has_labels)
+
+    def run_pass(self, pass_idx: int, total_words: int,
+                 n_segments: int = 1) -> None:
+        """One full corpus pass (epoch x iteration), optionally split
+        into ``n_segments`` scan dispatches so a caller can INTERLEAVE
+        several pipelines within a pass (ParagraphVectors: coarse
+        word-then-label sequencing saturates the predictive tables
+        before the label side sees a gradient).  Schedule, masking, and
+        update math are identical at any segmentation; the final ragged
+        segment's overrun spans are no-ops via the validity mask."""
+        for seg in range(n_segments):
+            self.run_segment(pass_idx, total_words, seg, n_segments)
+
+    def run_segment(self, pass_idx: int, total_words: int, seg: int,
+                    n_segments: int) -> None:
+        sv = self.sv
+        seg_spans = -(-self.n_spans // n_segments)
+        lo = seg * seg_spans
+        if lo >= self.n_spans:
+            return
         seen0 = pass_idx * self.n_words
-        starts = seen0 + np.arange(self.n_spans) * self.span
+        starts = seen0 + (lo + np.arange(seg_spans)) * self.span
         alphas = np.maximum(
             sv.min_learning_rate,
             sv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
@@ -489,11 +565,12 @@ class DeviceSkipGram(_TrainerCounters):
         syn1 = lt.syn1 if sv.use_hs else jnp.zeros((1, 1), jnp.float32)
         syn1neg = (lt.syn1neg if sv.negative > 0
                    else jnp.zeros((1, 1), jnp.float32))
-        syn0, syn1, syn1neg, pairs, loss = self._fn(
+        syn0, syn1, syn1neg, pairs, loss = self._seg_fn(seg_spans)(
             lt.syn0, syn1, syn1neg, self.corpus, self.sent,
-            jnp.int32(self.n_words), self.keep_prob, self.neg_table,
-            self.hs_points, self.hs_codes, self.hs_cmask,
-            jnp.asarray(alphas.astype(np.float32)), key)
+            self.pos_label, jnp.int32(self.n_words), self.keep_prob,
+            self.neg_table, self.hs_points, self.hs_codes,
+            self.hs_cmask, jnp.asarray(alphas.astype(np.float32)), key,
+            jnp.int32(lo))
         lt.syn0 = syn0
         if sv.use_hs:
             lt.syn1 = syn1
@@ -502,9 +579,10 @@ class DeviceSkipGram(_TrainerCounters):
         self._pending.append((pairs, loss))
 
 
-@functools.lru_cache(maxsize=8)
+@functools.lru_cache(maxsize=16)
 def _labelpair_epoch_fn(negative: int, use_hs: bool, chunk: int,
-                        n_chunks: int, subsample: bool):
+                        seg_chunks: int, total_chunks: int,
+                        subsample: bool):
     """PV-DBOW label->word training as one scan per corpus pass: each
     position contributes ONE (document label, word) pair (reference
     ``DBOW.java`` — no windowing), so the pipeline is the word2vec
@@ -516,8 +594,9 @@ def _labelpair_epoch_fn(negative: int, use_hs: bool, chunk: int,
         [[1.0], np.zeros(K)]).astype(np.float32)) if K > 0 else None
 
     def epoch(syn0, syn1, syn1neg, corpus, pos_label, keep_prob,
-              neg_table, hs_points, hs_codes, hs_cmask, alphas, key):
-        span_keys = jax.random.split(key, n_chunks)
+              neg_table, hs_points, hs_codes, hs_cmask, alphas, key,
+              chunk_offset):
+        span_keys = jax.random.split(key, seg_chunks)
 
         def body(carry, xs):
             syn0, syn1, syn1neg, pair_count, loss_sum = carry
@@ -526,6 +605,8 @@ def _labelpair_epoch_fn(negative: int, use_hs: bool, chunk: int,
             labs = jax.lax.dynamic_slice(pos_label, (c * chunk,),
                                          (chunk,))
             pm = (labs >= 0).astype(jnp.float32)   # -1 pads/OOV docs
+            # ragged-final-segment overrun spans are no-ops
+            pm = pm * (c < total_chunks).astype(jnp.float32)
             if subsample:
                 kb, kn = jax.random.split(ckey)
                 r = jax.random.uniform(kb, (chunk,))
@@ -555,7 +636,7 @@ def _labelpair_epoch_fn(negative: int, use_hs: bool, chunk: int,
                     loss_sum + loss), None
 
         init = (syn0, syn1, syn1neg, jnp.float32(0.0), jnp.float32(0.0))
-        xs = (jnp.arange(n_chunks), alphas, span_keys)
+        xs = (chunk_offset + jnp.arange(seg_chunks), alphas, span_keys)
         (syn0, syn1, syn1neg, pairs, loss), _ = jax.lax.scan(
             body, init, xs)
         return syn0, syn1, syn1neg, pairs, loss
@@ -588,14 +669,27 @@ class DeviceDbowLabels(_TrainerCounters):
         self.pos_label = jnp.asarray(pos_label)
         (self.keep_prob, self.neg_table, self.hs_points, self.hs_codes,
          self.hs_cmask) = _trainer_tables(pv)
-        self._fn = _labelpair_epoch_fn(int(pv.negative), pv.use_hs,
-                                       self.chunk, self.n_chunks,
-                                       pv.sampling > 0)
 
-    def run_pass(self, pass_idx: int, total_words: int) -> None:
+    def _seg_fn(self, seg_chunks: int):
         pv = self.pv
+        return _labelpair_epoch_fn(int(pv.negative), pv.use_hs,
+                                   self.chunk, seg_chunks,
+                                   self.n_chunks, pv.sampling > 0)
+
+    def run_pass(self, pass_idx: int, total_words: int,
+                 n_segments: int = 1) -> None:
+        for seg in range(n_segments):
+            self.run_segment(pass_idx, total_words, seg, n_segments)
+
+    def run_segment(self, pass_idx: int, total_words: int, seg: int,
+                    n_segments: int) -> None:
+        pv = self.pv
+        seg_chunks = -(-self.n_chunks // n_segments)
+        lo = seg * seg_chunks
+        if lo >= self.n_chunks:
+            return
         seen0 = pass_idx * self.n_words
-        starts = seen0 + np.arange(self.n_chunks) * self.chunk
+        starts = seen0 + (lo + np.arange(seg_chunks)) * self.chunk
         alphas = np.maximum(
             pv.min_learning_rate,
             pv.learning_rate * (1.0 - starts / max(total_words + 1, 1)))
@@ -604,11 +698,11 @@ class DeviceDbowLabels(_TrainerCounters):
         syn1 = lt.syn1 if pv.use_hs else jnp.zeros((1, 1), jnp.float32)
         syn1neg = (lt.syn1neg if pv.negative > 0
                    else jnp.zeros((1, 1), jnp.float32))
-        syn0, syn1, syn1neg, pairs, loss = self._fn(
+        syn0, syn1, syn1neg, pairs, loss = self._seg_fn(seg_chunks)(
             lt.syn0, syn1, syn1neg, self.corpus, self.pos_label,
             self.keep_prob, self.neg_table, self.hs_points,
             self.hs_codes, self.hs_cmask,
-            jnp.asarray(alphas.astype(np.float32)), key)
+            jnp.asarray(alphas.astype(np.float32)), key, jnp.int32(lo))
         lt.syn0 = syn0
         if pv.use_hs:
             lt.syn1 = syn1

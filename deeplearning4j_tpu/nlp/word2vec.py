@@ -333,35 +333,40 @@ class SequenceVectors:
         return {"pairs_trained": pipe.pairs_trained - prev_pairs,
                 "loss_sum": pipe.loss_sum - prev_loss, "passes": passes}
 
-    def _fit_device(self, seq_list, source=None,
-                    seqs_idx=None) -> "SequenceVectors":
-        """On-device corpus pipeline: one scan dispatch per corpus pass
-        (see ``nlp/device_corpus.py``).
-
-        The built pipeline (indexed corpus + device arrays + compiled
-        epoch fn) is CACHED across fit() calls keyed on the identity of
-        the caller's ``sequences`` object and the vocab — re-fitting the
-        same corpus (more epochs, lr sweeps) skips the ~0.3 s/M-words
-        host re-indexing and the corpus re-upload.  Mutating the same
-        sequence object in place between fits is not detected (the
-        ingest-cache posture: data is immutable while training on it)."""
+    def _device_word_pipe(self, seq_list, source=None, seqs_idx=None):
+        """The (possibly cached) word-side device pipeline, WITHOUT
+        running passes — callers that interleave several pipelines
+        (ParagraphVectors) drive run_pass themselves.  Cache keyed on
+        the caller's ``sequences`` object identity + vocab + baked
+        config (mutating the same sequence object in place between fits
+        is not detected — the ingest-cache posture: data is immutable
+        while training on it)."""
         from .device_corpus import DeviceSkipGram
         conf_key = self._device_conf_key()
         cached = getattr(self, "_device_fit_cache", None)
         if (cached is not None and source is not None
                 and cached[0] is source and cached[1] is self.vocab
                 and cached[2] == conf_key):
-            pipe = cached[3]
-        else:
-            seqs = (seqs_idx if seqs_idx is not None else
-                    [self._sequence_to_indices(s) for s in seq_list])
-            seqs = [s for s in seqs if s.size >= 2]
-            if not seqs:
-                return self
-            pipe = DeviceSkipGram(self, seqs)
-            if source is not None:
-                self._device_fit_cache = (source, self.vocab, conf_key,
-                                          pipe)
+            return cached[3]
+        seqs = (seqs_idx if seqs_idx is not None else
+                [self._sequence_to_indices(s) for s in seq_list])
+        seqs = [s for s in seqs if s.size >= 2]
+        if not seqs:
+            return None
+        pipe = DeviceSkipGram(self, seqs)
+        if source is not None:
+            self._device_fit_cache = (source, self.vocab, conf_key, pipe)
+        return pipe
+
+    def _fit_device(self, seq_list, source=None,
+                    seqs_idx=None) -> "SequenceVectors":
+        """On-device corpus pipeline: one scan dispatch per corpus pass
+        (see ``nlp/device_corpus.py``); the built pipeline caches
+        across fit() calls — re-fitting the same corpus skips the
+        ~0.3 s/M-words host re-indexing and the corpus re-upload."""
+        pipe = self._device_word_pipe(seq_list, source, seqs_idx)
+        if pipe is None:
+            return self
         stats = self._run_device_passes(pipe)
         stats.update(span=pipe.span, n_spans=pipe.n_spans)
         self._device_pipeline_stats = stats

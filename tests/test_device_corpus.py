@@ -354,16 +354,16 @@ def test_pv_routing_gates():
     docs = [(["a", "b"], "DOC_0")]
     dm = ParagraphVectors(sequence_learning_algorithm="dm",
                           pair_generation="device", layer_size=8)
-    assert not dm._device_eligible_dbow(docs)   # DM keeps host loop
+    assert dm._device_eligible_pv(docs)         # DM device path too
 
     class Custom(ParagraphVectors):
         def _train_document(self, tokens, label, alpha):
             return super()._train_document(tokens, label, alpha)
 
     c = Custom(pair_generation="device", layer_size=8)
-    assert not c._device_eligible_dbow(docs)    # overridden hook -> host
+    assert not c._device_eligible_pv(docs)      # overridden hook -> host
     d = ParagraphVectors(pair_generation="device", layer_size=8)
-    assert d._device_eligible_dbow(docs)
+    assert d._device_eligible_pv(docs)
 
 
 def test_pv_dbow_cached_refit_trains_both_sides_fresh_rng():
@@ -414,3 +414,81 @@ def test_interleaved_label_arrays_bound_duplicates():
         labs = labs[labs >= 0]
         if labs.size:
             assert np.bincount(labs).max() <= 7
+
+
+@pytest.mark.parametrize("hs,neg,epochs", [(True, 0.0, 4),
+                                           (False, 5.0, 10)])
+def test_pv_dm_device_learns_doc_topics(hs, neg, epochs):
+    """Device DM at each mode's converged regime on this micro-corpus
+    (the device pass alternates word/label segments ~16x per pass —
+    coarser than the host's per-document interleave, so convergence
+    pacing differs by mode on tiny corpora; auto routing therefore
+    keeps DM on host, device is explicit opt-in)."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(15)
+    docs = _doc_corpus(rng)
+    pv = ParagraphVectors(sequence_learning_algorithm="dm",
+                          layer_size=24, window_size=3, epochs=epochs,
+                          negative=neg, use_hierarchic_softmax=hs,
+                          min_word_frequency=1, pair_generation="device")
+    pv.fit(docs)
+    assert pv._device_dm_stats["pairs_trained"] > 0
+    same, diff = _label_sims(pv)
+    assert same > diff
+
+
+def test_pv_dm_host_and_device_agree_on_quality():
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(16)
+    docs = _doc_corpus(rng)
+    for pg, epochs in (("host", 4), ("device", 10)):
+        pv = ParagraphVectors(sequence_learning_algorithm="dm",
+                              layer_size=24, window_size=3, epochs=epochs,
+                              negative=5.0, use_hierarchic_softmax=False,
+                              min_word_frequency=1, pair_generation=pg)
+        pv.fit(docs)
+        same, diff = _label_sims(pv)
+        assert same > diff, (pg, same, diff)
+
+
+def test_pv_dm_auto_keeps_host_loop():
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    docs = [(["a", "b"], "DOC_0")]
+    dm_auto = ParagraphVectors(sequence_learning_algorithm="dm",
+                               layer_size=8)      # pair_generation="auto"
+    assert not dm_auto._device_eligible_pv(docs)
+
+
+def test_pv_dm_single_word_documents_train_from_label():
+    """A single-word document has no context window; the label column
+    alone must still train (the host path's fallback)."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    docs = [["only%d" % (i % 5)] for i in range(30)]
+    pv = ParagraphVectors(sequence_learning_algorithm="dm",
+                          layer_size=8, epochs=2, negative=3.0,
+                          use_hierarchic_softmax=False,
+                          min_word_frequency=1, pair_generation="device")
+    pv.fit(docs)
+    assert pv._device_dm_stats["pairs_trained"] > 0
+    v = pv.label_vector("DOC_0")
+    assert v is not None and np.isfinite(v).all()
+
+
+def test_pv_word_side_trains_when_labels_unresolvable():
+    """With a pre-built vocab that lacks the labels, the word side must
+    still train (baseline behavior) and the label stats must be zeroed,
+    not stale."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    rng = np.random.RandomState(21)
+    docs = _doc_corpus(rng, n_docs=60)
+    pv = ParagraphVectors(layer_size=12, window_size=3, epochs=1,
+                          negative=5.0, use_hierarchic_softmax=False,
+                          min_word_frequency=1, pair_generation="device")
+    # vocab from sequences only -> DOC_* labels are absent
+    pv.build_vocab([list(d.split()) for d in docs])
+    w0 = pv.word_vector("sci1").copy()
+    pv.fit(docs)
+    assert pv._device_dbow_stats == {"pairs_trained": 0.0,
+                                     "loss_sum": 0.0, "passes": 0}
+    assert pv._device_pipeline_stats["pairs_trained"] > 0
+    assert not np.allclose(w0, pv.word_vector("sci1"))
