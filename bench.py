@@ -31,6 +31,8 @@ import time
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
+
 # Recorded floor for the LeNet config (BASELINE.md "Generated baselines"):
 # round-1 CPU-XLA floor on this image (the reference publishes no numbers).
 BASELINE_SAMPLES_PER_SEC = 1488.0
@@ -157,6 +159,15 @@ def _roofline_fields(cost: dict, steps_per_sec: float) -> dict:
     return out
 
 
+def _phase_fields(snap: dict) -> dict:
+    """Per-phase wall-clock attribution since ``snap`` (a
+    ``monitor.snapshot()`` taken at bench start): data/step/listener/
+    compile ms plus the recompile count, read from the telemetry
+    registry the runtime now feeds — BENCH_r*.json snapshots carry
+    phase attribution, not just a rate."""
+    return {"phases": monitor.phase_breakdown(since=snap)}
+
+
 def _run_scan_bench(net, feats, labels, steps: int, pipeline: int,
                     trials: int):
     """Shared harness for the net-based configs: AOT-compile the on-chip
@@ -197,7 +208,12 @@ def _run_scan_bench(net, feats, labels, steps: int, pipeline: int,
         for _ in range(pipeline):
             scores = dispatch()
         float(np.asarray(scores)[-1])
-        return time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        # one observation per timed window (pipeline*steps on-chip
+        # steps): zero per-step overhead, and the registry still carries
+        # the step-phase total for the breakdown line
+        monitor.observe_phase("step", elapsed)
+        return elapsed
 
     meas = _measured(timed, trials)
     net.params, net.updater_state = state["p"], state["u"]
@@ -216,7 +232,9 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
 
     conf = lenet(compute_dtype=_bf16_if_tpu())
     net = MultiLayerNetwork(conf).init()
+    snap = monitor.snapshot()
 
+    t_data = time.perf_counter()
     features, labels = mnist_arrays(train=True, num_examples=batch * 8)
     n = features.shape[0] // batch
     # stack the 8 distinct minibatches cyclically into (steps, B, ...) and
@@ -242,6 +260,7 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
     f_stk = jax.jit(lambda d, i: d[i])(f_dev, idx)
     l_stk = jax.jit(lambda d, i: d[i])(l_dev, idx)
     jax.block_until_ready((f_stk, l_stk))
+    monitor.observe_phase("data", time.perf_counter() - t_data)
 
     # Dispatches are PIPELINED — `pipeline` async launches per
     # device->host completion fetch (the only reliable barrier over the
@@ -260,6 +279,7 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
     }
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -280,6 +300,8 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
     bf16 = _bf16_if_tpu()
     conf = resnet50(compute_dtype=bf16)
     net = ComputationGraph(conf).init()
+    snap = monitor.snapshot()
+    t_data = time.perf_counter()
     rng = np.random.RandomState(0)
     in_dtype = np.dtype("float32") if bf16 is None else jnp.bfloat16
     f = rng.rand(batch, 224, 224, 3).astype(np.float32)
@@ -291,6 +313,7 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
                              (steps,) + f.shape)
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
+    monitor.observe_phase("data", time.perf_counter() - t_data)
 
     meas, cost = _run_scan_bench(net, [f_stk], [l_stk], steps,
                                  pipeline, trials)
@@ -301,6 +324,7 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
               "vs_baseline": None, "batch": batch}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -333,6 +357,8 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
                                   activation="softmax", loss="mcxent"))
             .build())
     net = MultiLayerNetwork(conf).init()
+    snap = monitor.snapshot()
+    t_data = time.perf_counter()
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq))
     f = np.eye(vocab, dtype=np.float32)[ids]
@@ -341,6 +367,7 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
     f_stk = jnp.broadcast_to(jnp.asarray(f), (steps,) + f.shape)
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
+    monitor.observe_phase("data", time.perf_counter() - t_data)
 
     meas, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
                                  trials)
@@ -351,6 +378,7 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
               "vs_baseline": None, "batch": batch, "seq": seq}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -370,6 +398,8 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
     bf16 = _bf16_if_tpu()
     conf = vgg16(compute_dtype=bf16)
     net = MultiLayerNetwork(conf).init()
+    snap = monitor.snapshot()
+    t_data = time.perf_counter()
     rng = np.random.RandomState(0)
     in_dtype = np.dtype("float32") if bf16 is None else jnp.bfloat16
     f = rng.rand(batch, 224, 224, 3).astype(np.float32)
@@ -379,6 +409,7 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
                              (steps,) + f.shape)
     l_stk = jnp.broadcast_to(jnp.asarray(l), (steps,) + l.shape)
     jax.block_until_ready((f_stk, l_stk))
+    monitor.observe_phase("data", time.perf_counter() - t_data)
 
     meas, cost = _run_scan_bench(net, f_stk, l_stk, steps, pipeline,
                                  trials)
@@ -389,6 +420,7 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
               "vs_baseline": None, "batch": batch}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -576,6 +608,7 @@ def bench_fit_iterator_resnet(batch: int = 128, examples: int = 1280,
         f = f.astype(ml_dtypes.bfloat16)
     l = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, examples)]
     it = ListDataSetIterator(DataSet(f, l), batch)
+    snap = monitor.snapshot()        # fit() feeds the phase registry itself
     net.fit(it, epochs=1)            # warmup: upload + compile
 
     def timed() -> float:
@@ -592,6 +625,7 @@ def bench_fit_iterator_resnet(batch: int = 128, examples: int = 1280,
               "vs_baseline": None, "batch": batch,
               "examples_per_epoch": examples}
     result.update(_band_fields(meas, work, trials))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -612,6 +646,7 @@ def bench_native_ingest(batch: int = 256, steps: int = 50,
     it = AsyncDataSetIterator(
         MnistDataSetIterator(batch, batch * steps), queue_size=4)
     native = it.native
+    snap = monitor.snapshot()        # fit_scan feeds the phase registry
 
     def epoch() -> None:
         batches = list(it)
@@ -633,6 +668,7 @@ def bench_native_ingest(batch: int = 256, steps: int = 50,
               "vs_baseline": None, "batch": batch,
               "native_prefetcher": bool(native)}
     result.update(_band_fields(meas, work, trials))
+    result.update(_phase_fields(snap))
     return result
 
 
@@ -654,6 +690,7 @@ def bench_fit_iterator(batch: int = 256, examples: int = 60000,
     for mode in ("cache", "window"):
         net = MultiLayerNetwork(lenet(compute_dtype=_bf16_if_tpu())).init()
         it = MnistDataSetIterator(batch, examples)
+        snap = monitor.snapshot()   # fit() feeds the phase registry itself
         net.fit(it, epochs=1, ingest=mode)   # warmup: compile + first epoch
 
         def timed() -> float:
@@ -670,6 +707,7 @@ def bench_fit_iterator(batch: int = 256, examples: int = 60000,
                   "vs_baseline": None, "batch": batch,
                   "examples_per_epoch": examples}
         result.update(_band_fields(meas, work, trials))
+        result.update(_phase_fields(snap))
         results.append(result)
     return results
 

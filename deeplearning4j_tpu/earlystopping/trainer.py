@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import monitor as _monitor
 from .config import EarlyStoppingConfiguration, EarlyStoppingResult
 
 
@@ -37,7 +38,10 @@ class EarlyStoppingTrainer:
 
         epoch = 0
         while True:
-            self._fit_one_epoch()
+            with _monitor.span("earlystopping/epoch", epoch=epoch):
+                self._fit_one_epoch()
+            _monitor.counter("earlystopping_epochs_total",
+                             "early-stopping training epochs run").inc()
 
             # Iteration conditions (time/divergence) checked on latest score
             stop_iter = None
@@ -57,6 +61,9 @@ class EarlyStoppingTrainer:
                 if score < result.best_model_score:
                     result.best_model_score = float(score)
                     result.best_model_epoch = epoch
+                    _monitor.gauge("earlystopping_best_score",
+                                   "best early-stopping model score so "
+                                   "far").set(float(score))
                     if cfg.model_saver:
                         cfg.model_saver.save_best_model(net, score)
                     else:

@@ -1,0 +1,173 @@
+"""``watched_jit``: a ``jax.jit`` wrapper that makes recompiles visible.
+
+``jax.jit`` retraces whenever the abstract signature of the arguments
+changes — tree structure, leaf shapes/dtypes, or a static argument's
+value.  Silent shape churn (ragged final batches, per-length tbptt
+windows) turns a "compiled once" training loop into one that recompiles
+every few steps, and nothing in the stack reports it.  ``WatchedJit``
+computes the same abstract signature jax uses for its cache key and
+keeps a seen-set per wrapped function, so it can tell a first-time
+compile from a cache hit *before* dispatching:
+
+- ``jit_compiles_total{fn=...}`` / ``jit_cache_hits_total{fn=...}``
+  counters in the global registry;
+- ``jit_compile_ms{fn=...}`` histogram — wall time of each compiling
+  call (trace + compile + first dispatch; subsequent calls bypass all
+  bookkeeping except one set lookup and a counter inc);
+- a ``jit/compile/<name>`` tracing span whose ``signature`` attribute is
+  the exact abstract shape that triggered the retrace, so the trace dump
+  answers *why* it recompiled.
+
+Python scalars are weak-typed under jit — a value change does **not**
+retrace — so they hash as ``int[]``/``float[]``/``bool[]`` rather than
+by value.  ``static_argnums`` values **do** retrace, so they hash by
+``repr``.  The AOT path (``.lower(...).compile()``, used by bench.py and
+tools/hbm_profile.py) is proxied: ``compile()`` is timed and counted,
+but does not feed the seen-set since jax's jit cache and the AOT cache
+are separate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence, Set, Tuple
+
+import jax
+
+from .metrics import registry
+from .tracing import tracer
+
+COMPILES_TOTAL = "jit_compiles_total"
+CACHE_HITS_TOTAL = "jit_cache_hits_total"
+COMPILE_MS = "jit_compile_ms"
+
+_HELP = {
+    COMPILES_TOTAL: "jitted-function compilations (first call per "
+                    "abstract signature)",
+    CACHE_HITS_TOTAL: "jitted-function calls served from the trace cache",
+    COMPILE_MS: "wall time of each compiling call (trace + compile + "
+                "first dispatch, ms)",
+}
+
+
+def _leaf_desc(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    # Weak-typed python scalars: value changes do not retrace.
+    if isinstance(leaf, bool):
+        return "bool[]"
+    if isinstance(leaf, int):
+        return "int[]"
+    if isinstance(leaf, float):
+        return "float[]"
+    if isinstance(leaf, complex):
+        return "complex[]"
+    return repr(leaf)
+
+
+def abstract_signature(args: Tuple, kwargs: dict,
+                       static_argnums: Sequence[int] = ()) -> str:
+    """A string mirroring jax.jit's cache key for this call: static args
+    by value, dynamic args by treedef + per-leaf ``dtype[shape]``."""
+    static = set(static_argnums or ())
+    parts = []
+    for i, arg in enumerate(args):
+        if i in static:
+            parts.append(f"static{i}={arg!r}")
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(arg)
+            descs = ",".join(_leaf_desc(l) for l in leaves)
+            parts.append(f"{treedef}:{descs}")
+    for k in sorted(kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten(kwargs[k])
+        descs = ",".join(_leaf_desc(l) for l in leaves)
+        parts.append(f"{k}={treedef}:{descs}")
+    return "; ".join(parts)
+
+
+class _LoweredProxy:
+    """Wraps ``jitted.lower(...)`` so the explicit AOT ``compile()`` is
+    timed and counted like an implicit one."""
+
+    def __init__(self, lowered, name: str, signature: str):
+        self._lowered = lowered
+        self._name = name
+        self._signature = signature
+
+    def compile(self, *args, **kwargs):
+        reg = registry()
+        t0 = time.perf_counter()
+        with tracer().span(f"jit/compile/{self._name}", mode="aot",
+                           signature=self._signature):
+            compiled = self._lowered.compile(*args, **kwargs)
+        elapsed = time.perf_counter() - t0
+        reg.counter(COMPILES_TOTAL, _HELP[COMPILES_TOTAL]).inc(
+            fn=self._name)
+        reg.histogram(COMPILE_MS, _HELP[COMPILE_MS]).observe(
+            elapsed * 1e3, fn=self._name)
+        return compiled
+
+    def __getattr__(self, item):
+        return getattr(self._lowered, item)
+
+
+class WatchedJit:
+    """Callable wrapper around ``jax.jit(fn, ...)`` that records compile
+    vs cache-hit telemetry into the global monitor registry/tracer."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 static_argnums: Sequence[int] = (),
+                 donate_argnums: Sequence[int] = (), **jit_kwargs):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "jit_fn")
+        self._static_argnums = tuple(static_argnums or ())
+        jit_kw = dict(jit_kwargs)
+        if self._static_argnums:
+            jit_kw["static_argnums"] = self._static_argnums
+        if donate_argnums:
+            jit_kw["donate_argnums"] = tuple(donate_argnums)
+        self._jitted = jax.jit(fn, **jit_kw)
+        self._seen: Set[str] = set()
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        signature = abstract_signature(args, kwargs, self._static_argnums)
+        reg = registry()
+        if signature in self._seen:
+            reg.counter(CACHE_HITS_TOTAL, _HELP[CACHE_HITS_TOTAL]).inc(
+                fn=self.name)
+            return self._jitted(*args, **kwargs)
+        recompile = bool(self._seen)
+        self._seen.add(signature)
+        t0 = time.perf_counter()
+        with tracer().span(f"jit/compile/{self.name}",
+                           signature=signature, recompile=recompile):
+            out = self._jitted(*args, **kwargs)
+        elapsed = time.perf_counter() - t0
+        reg.counter(COMPILES_TOTAL, _HELP[COMPILES_TOTAL]).inc(fn=self.name)
+        reg.histogram(COMPILE_MS, _HELP[COMPILE_MS]).observe(
+            elapsed * 1e3, fn=self.name)
+        return out
+
+    def lower(self, *args, **kwargs) -> _LoweredProxy:
+        signature = abstract_signature(args, kwargs, self._static_argnums)
+        return _LoweredProxy(self._jitted.lower(*args, **kwargs),
+                             self.name, signature)
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._seen)
+
+    def __getattr__(self, item):
+        # Fallback for jitted-function attributes (e.g. clear_cache).
+        return getattr(self._jitted, item)
+
+
+def watched_jit(fn: Callable, name: Optional[str] = None,
+                **kwargs) -> WatchedJit:
+    """Drop-in for ``jax.jit(fn, ...)`` with compile-watch telemetry.
+    Extra keyword arguments (``donate_argnums``, ``static_argnums``, …)
+    pass through to ``jax.jit``."""
+    return WatchedJit(fn, name=name, **kwargs)

@@ -1,0 +1,117 @@
+"""Tracing spans: nested host wall-clock attribution in a ring buffer.
+
+``span("fit/epoch")`` is a context manager; finished spans land in a
+bounded thread-safe ring buffer with parent/child nesting (per-thread
+parent stack), per-span wall time, and arbitrary JSON-able attributes.
+The dump format is the Chrome trace-event format, one complete event
+(``"ph": "X"``) per span — ``to_jsonl()`` emits one event per line, and
+wrapping the lines in ``[...]`` (what ``ui/server.py``'s ``/trace``
+endpoint documents) loads directly in Perfetto / chrome://tracing.
+
+Overhead budget: one ``perf_counter`` pair, a dict build and a deque
+append per span — sub-10 µs, safe to put around per-iteration work (the
+per-phase *histograms* in :mod:`.metrics` are the per-iteration hot-path
+surface; spans mark the structural regions: epochs, dispatch windows,
+compiles, parallel rounds).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 4096
+
+
+class Tracer:
+    """Bounded ring buffer of finished spans + per-thread nesting stack."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._buf = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+
+    # ------------------------------------------------------------ recording
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Time a region.  Nested calls on the same thread record their
+        enclosing span's id as ``parent``."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        span_id = next(self._ids)
+        parent = stack[-1] if stack else None
+        stack.append(span_id)
+        wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield span_id
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            stack.pop()
+            event = {
+                "id": span_id,
+                "parent": parent,
+                "name": name,
+                "ts": wall,
+                "dur_ms": round(dur_ms, 6),
+                "thread": threading.get_ident(),
+            }
+            if attrs:
+                event["attrs"] = attrs
+            with self._lock:
+                self._buf.append(event)
+
+    # -------------------------------------------------------------- reading
+    def events(self) -> List[Dict]:
+        """Finished spans, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def chrome_events(self) -> List[Dict]:
+        """Spans as Chrome trace-event objects (``ph: "X"``, µs units)."""
+        pid = os.getpid()
+        out = []
+        for e in self.events():
+            ev = {
+                "name": e["name"],
+                "ph": "X",
+                "ts": round(e["ts"] * 1e6, 1),
+                "dur": round(e["dur_ms"] * 1e3, 1),
+                "pid": pid,
+                "tid": e["thread"],
+                "args": dict(e.get("attrs") or {},
+                             span_id=e["id"], parent=e["parent"]),
+            }
+            out.append(ev)
+        return out
+
+    def to_jsonl(self) -> str:
+        """One Chrome trace event per line (``[`` + ``",".join(lines)`` +
+        ``]`` is a loadable Chrome/Perfetto trace)."""
+        return "\n".join(json.dumps(ev, default=str)
+                         for ev in self.chrome_events())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-global tracer."""
+    return _TRACER
+
+
+def span(name: str, **attrs):
+    """Convenience: ``with monitor.span("fit/epoch", epoch=3): ...``"""
+    return _TRACER.span(name, **attrs)

@@ -14,6 +14,7 @@ Multi-input/multi-output batches are :class:`MultiDataSet` pytrees.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import updaters as _updaters
+from .. import monitor as _monitor
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      DuplicateToTimeSeriesVertex,
                                      LastTimeStepVertex, LayerVertex)
@@ -273,7 +275,8 @@ class ComputationGraph:
             score = data_loss + self._reg_score(params)
             return new_params, new_ustate, new_state, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(step, name="cg.train_step",
+                                    donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _multi_train_step(self):
@@ -304,7 +307,8 @@ class ComputationGraph:
                 (features, labels, features_masks, labels_masks))
             return params, updater_state, net_state, scores
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(multi, name="cg.multi_train_step",
+                                    donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _gather_train_step(self):
@@ -334,7 +338,8 @@ class ComputationGraph:
                 body, init, idx)
             return params, updater_state, net_state, scores
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(multi, name="cg.gather_train_step",
+                                    donate_argnums=(0, 1, 2))
 
     def _fit_device_cached(self, source, epochs: int):
         """Graph twin of ``MultiLayerNetwork._fit_device_cached``:
@@ -346,26 +351,41 @@ class ComputationGraph:
         dev_f, dev_l = ingest.device_cached_arrays(self, source._ds)
         data_fs, data_ls = (dev_f,), (dev_l,)
         replay = ingest.ScoreReplayer(self)
+        iters = _monitor.counter("train_iterations_total",
+                                 "supervised train iterations")
         for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            order = ingest.epoch_order(source)
-            for idx in ingest.epoch_index_batches(order, source._batch):
-                (self.params, self.updater_state, self.net_state,
-                 scores) = self._gather_train_step(
-                    self.params, self.updater_state, self.net_state,
-                    self.iteration, data_fs, data_ls, jnp.asarray(idx),
-                    self._rng_key)
-                replay.add(self.iteration, scores)
-                self.iteration += idx.shape[0]
-                self.last_batch_size = idx.shape[1]
-            if self.listeners:
-                replay.replay()
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+            with _monitor.span("fit/epoch", epoch=self.epoch,
+                               path="cache"):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                t0 = time.perf_counter()
+                order = ingest.epoch_order(source)
+                batches = list(ingest.epoch_index_batches(
+                    order, source._batch))
+                _monitor.observe_phase("data", time.perf_counter() - t0)
+                for idx in batches:
+                    t1 = time.perf_counter()
+                    (self.params, self.updater_state, self.net_state,
+                     scores) = self._gather_train_step(
+                        self.params, self.updater_state, self.net_state,
+                        self.iteration, data_fs, data_ls, jnp.asarray(idx),
+                        self._rng_key)
+                    replay.add(self.iteration, scores)
+                    _monitor.observe_phase("step",
+                                           time.perf_counter() - t1)
+                    iters.inc(idx.shape[0])
+                    self.iteration += idx.shape[0]
+                    self.last_batch_size = idx.shape[1]
+                if self.listeners:
+                    t2 = time.perf_counter()
+                    replay.replay()
+                    _monitor.observe_phase("listener",
+                                           time.perf_counter() - t2)
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
         replay.finish()
         return self
 
@@ -378,47 +398,57 @@ class ComputationGraph:
         replay = ingest.ScoreReplayer(self)
 
         def dispatch(buf):
+            t0 = time.perf_counter()
             features, labels, fms, lms = ingest.stack_multi_window(buf)
             cdt = self.conf.conf.compute_dtype
             features = [ingest.cast_for_transfer(f, cdt) for f in features]
+            features = [jnp.asarray(f) for f in features]
+            labels = [jnp.asarray(l) for l in labels]
+            fms = (None if fms is None else [
+                None if m is None else jnp.asarray(m) for m in fms])
+            lms = (None if lms is None else [
+                None if m is None else jnp.asarray(m) for m in lms])
+            t1 = time.perf_counter()
+            _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,
-                self.iteration,
-                [jnp.asarray(f) for f in features],
-                [jnp.asarray(l) for l in labels],
-                None if fms is None else [
-                    None if m is None else jnp.asarray(m) for m in fms],
-                None if lms is None else [
-                    None if m is None else jnp.asarray(m) for m in lms],
-                self._rng_key)
+                self.iteration, features, labels, fms, lms, self._rng_key)
             replay.add(self.iteration, scores)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
+            _monitor.counter("train_iterations_total",
+                             "supervised train iterations").inc(len(buf))
             self.iteration += len(buf)
             self.last_batch_size = buf[0].num_examples()
 
         for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            buf, sig = [], None
-            for ds in iterator:
-                mds = _as_multi(ds)
-                s = ingest.multi_window_signature(mds)
-                if buf and (s != sig or len(buf) >= window):
+            with _monitor.span("fit/epoch", epoch=self.epoch,
+                               path="window"):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                buf, sig = [], None
+                for ds in iterator:
+                    mds = _as_multi(ds)
+                    s = ingest.multi_window_signature(mds)
+                    if buf and (s != sig or len(buf) >= window):
+                        dispatch(buf)
+                        buf = []
+                    sig = s
+                    buf.append(mds)
+                if buf:
                     dispatch(buf)
-                    buf = []
-                sig = s
-                buf.append(mds)
-            if buf:
-                dispatch(buf)
-            if self.listeners:
-                replay.replay()
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+                if self.listeners:
+                    t2 = time.perf_counter()
+                    replay.replay()
+                    _monitor.observe_phase("listener",
+                                           time.perf_counter() - t2)
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
         replay.finish()
         return self
 
@@ -471,15 +501,18 @@ class ComputationGraph:
         labels = stack_inputs(lambda m: m.labels, n_out)
         fmasks = stack_masks(lambda m: m.features_masks, n_in)
         lmasks = stack_masks(lambda m: m.labels_masks, n_out)
+        t1 = time.perf_counter()
         (self.params, self.updater_state, self.net_state,
          scores) = self._multi_train_step(
             self.params, self.updater_state, self.net_state, self.iteration,
             features, labels, fmasks, lmasks, self._rng_key)
+        _monitor.observe_phase("step", time.perf_counter() - t1)
+        _monitor.counter("train_iterations_total",
+                         "supervised train iterations").inc(len(mbs))
         self.iteration += len(mbs)
         self._score = scores[-1]
         self.last_batch_size = mbs[0].num_examples()
-        for listener in self.listeners:
-            listener.iteration_done(self, self.iteration)
+        self._fire_listeners()
         return np.asarray(scores)
 
     @functools.cached_property
@@ -507,7 +540,8 @@ class ComputationGraph:
             score = data_loss + self._reg_score(params)
             return (new_params, new_ustate, new_state, new_carries, score)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        return _monitor.watched_jit(step, name="cg.tbptt_step",
+                                    donate_argnums=(0, 1, 2, 3))
 
     @functools.cached_property
     def _advance_fn(self):
@@ -528,7 +562,7 @@ class ComputationGraph:
                 input_masks=input_masks, carries=carries)
             return [acts[o] for o in self.conf.network_outputs], new_carries
 
-        return jax.jit(run)
+        return _monitor.watched_jit(run, name="cg.advance")
 
     @functools.cached_property
     def _output_fn(self):
@@ -542,7 +576,7 @@ class ComputationGraph:
                                        train=False, rng=None,
                                        input_masks=input_masks)
             return [acts[o] for o in self.conf.network_outputs]
-        return jax.jit(run)
+        return _monitor.watched_jit(run, name="cg.output")
 
     @functools.cached_property
     def _score_fn(self):
@@ -552,11 +586,12 @@ class ComputationGraph:
                 params, net_state, features, labels, features_masks,
                 labels_masks, None, False)
             return data_loss + self._reg_score(params)
-        return jax.jit(score)
+        return _monitor.watched_jit(score, name="cg.score")
 
     @functools.cached_property
     def _score_examples_fn(self):
-        @functools.partial(jax.jit, static_argnums=(6,))
+        @functools.partial(_monitor.watched_jit,
+                           name="cg.score_examples", static_argnums=(6,))
         def run(params, net_state, features, labels, features_masks,
                 labels_masks, add_reg):
             per, _ = self._loss_fn(params, net_state, features, labels,
@@ -628,8 +663,8 @@ class ComputationGraph:
                     params[name], layer.l1_by_param(), layer.l2_by_param())
                 return new_p, new_ustate, score
 
-            self._pretrain_step_cache[name] = jax.jit(step,
-                                                      donate_argnums=(1,))
+            self._pretrain_step_cache[name] = _monitor.watched_jit(
+                step, name=f"cg.pretrain_step_{name}", donate_argnums=(1,))
         return self._pretrain_step_cache[name]
 
     def pretrain(self, data, epochs: int = 1) -> "ComputationGraph":
@@ -669,8 +704,7 @@ class ComputationGraph:
                                self._rng_key)
                 self._score = score
                 self.iteration += 1
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                self._fire_listeners()
         return self
 
     # ------------------------------------------------------------------- fit
@@ -701,48 +735,67 @@ class ComputationGraph:
         else:
             iterator = data
             batches = None
-        if self.conf.pretrain and not self._pretrain_done:
-            if batches is None and not hasattr(iterator, "reset"):
-                # One-shot iterable: materialize so layer-wise pretraining
-                # and the supervised phase each see the full data.
-                batches = list(iterator)
-                iterator = None
-            self.pretrain(batches if batches is not None else iterator)
-            self._pretrain_done = True
-        if not getattr(self.conf, "backprop", True):
+        from ..optimize.listeners.listeners import finalize_listeners
+        try:
+            if self.conf.pretrain and not self._pretrain_done:
+                if batches is None and not hasattr(iterator, "reset"):
+                    # One-shot iterable: materialize so layer-wise
+                    # pretraining and the supervised phase each see the
+                    # full data.
+                    batches = list(iterator)
+                    iterator = None
+                self.pretrain(batches if batches is not None else iterator)
+                self._pretrain_done = True
+            if not getattr(self.conf, "backprop", True):
+                return self
+            if (iterator is not None and ingest != "batch"
+                    and self._solver is None
+                    and getattr(self.conf, "backprop_type",
+                                "standard") != "tbptt"
+                    and self.conf.conf.num_iterations == 1):
+                from . import ingest as ingest_mod
+                if ingest in ("auto", "cache"):
+                    source = ingest_mod.cacheable_source(iterator)
+                    if source is not None:
+                        return self._fit_device_cached(source, epochs)
+                    if ingest == "cache":
+                        raise ValueError(
+                            "ingest='cache' but the iterator is not "
+                            "device-cacheable (see nn/ingest.py "
+                            "eligibility)")
+                return self._fit_windowed(iterator, epochs, window)
+            for _ in range(epochs):
+                with _monitor.span("fit/epoch", epoch=self.epoch,
+                                   path="batch"):
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_start"):
+                            listener.on_epoch_start(self)
+                    it = batches if batches is not None else iterator
+                    if hasattr(it, "reset"):
+                        it.reset()
+                    for ds in it:
+                        self._fit_batch(_as_multi(ds))
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_end"):
+                            listener.on_epoch_end(self)
+                    self.epoch += 1
             return self
-        if (iterator is not None and ingest != "batch"
-                and self._solver is None
-                and getattr(self.conf, "backprop_type",
-                            "standard") != "tbptt"
-                and self.conf.conf.num_iterations == 1):
-            from . import ingest as ingest_mod
-            if ingest in ("auto", "cache"):
-                source = ingest_mod.cacheable_source(iterator)
-                if source is not None:
-                    return self._fit_device_cached(source, epochs)
-                if ingest == "cache":
-                    raise ValueError(
-                        "ingest='cache' but the iterator is not "
-                        "device-cacheable (see nn/ingest.py eligibility)")
-            return self._fit_windowed(iterator, epochs, window)
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            it = batches if batches is not None else iterator
-            if hasattr(it, "reset"):
-                it.reset()
-            for ds in it:
-                self._fit_batch(_as_multi(ds))
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
-        return self
+        finally:
+            finalize_listeners(self.listeners)
+
+    def _fire_listeners(self) -> None:
+        """Per-iteration listener callbacks, timed as the ``listener``
+        phase (they run on the host and may force a device score fetch)."""
+        if not self.listeners:
+            return
+        t0 = time.perf_counter()
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        _monitor.observe_phase("listener", time.perf_counter() - t0)
 
     def _fit_batch(self, mds: MultiDataSet) -> None:
         self.last_batch_size = mds.num_examples()
+        t0 = time.perf_counter()
         features = tuple(jnp.asarray(f) for f in mds.features)
         labels = tuple(jnp.asarray(l) for l in mds.labels)
         fmasks = (None if mds.features_masks is None else tuple(
@@ -750,28 +803,35 @@ class ComputationGraph:
             for m in mds.features_masks))
         lmasks = (None if mds.labels_masks is None else tuple(
             None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+        _monitor.observe_phase("data", time.perf_counter() - t0)
+        iters = _monitor.counter("train_iterations_total",
+                                 "supervised train iterations")
         if self._solver is not None:
             for _ in range(self.conf.conf.num_iterations):
+                t1 = time.perf_counter()
                 self._score = self._solver.optimize(features, labels,
                                                     fmasks, lmasks)
+                _monitor.observe_phase("step", time.perf_counter() - t1)
                 self.iteration += 1
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                iters.inc()
+                self._fire_listeners()
             return
         if getattr(self.conf, "backprop_type", "standard") == "tbptt":
             for _ in range(self.conf.conf.num_iterations):
                 self._fit_tbptt(features, labels, fmasks, lmasks)
             return
         for _ in range(self.conf.conf.num_iterations):
+            t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state,
              score) = self._train_step(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fmasks, lmasks,
                 self._rng_key)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
             self._score = score
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            iters.inc()
+            self._fire_listeners()
 
     # ---------------------------------------------------------------- tBPTT
     def _fit_tbptt(self, features, labels, fmasks, lmasks) -> None:
@@ -830,6 +890,7 @@ class ComputationGraph:
                                                    masks=True))
                 start = start + adv
             sl = slice(start, stop)
+            t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state, carries,
              score) = self._tbptt_step(
                 self.params, self.updater_state, self.net_state, carries,
@@ -837,10 +898,12 @@ class ComputationGraph:
                 None if fmasks is None else _t(fmasks, sl, masks=True),
                 None if lmasks is None else _t(lmasks, sl, masks=True),
                 self._rng_key)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
             scores.append(score)
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            _monitor.counter("train_iterations_total",
+                             "supervised train iterations").inc()
+            self._fire_listeners()
         self._score = scores[-1] if scores else self._score
 
     def _recurrent_vertex_names(self) -> List[str]:

@@ -17,6 +17,7 @@ exposes the reference's flat-vector invariant via deterministic raveling.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import updaters as _updaters
+from .. import monitor as _monitor
 from .conf.neural_net_configuration import MultiLayerConfiguration
 from ..datasets.dataset import DataSet
 
@@ -245,7 +247,8 @@ class MultiLayerNetwork:
             score = data_loss + self._reg_score(params)
             return new_params, new_updater_state, new_state, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(step, name="mln.train_step",
+                                    donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _multi_train_step(self):
@@ -274,7 +277,8 @@ class MultiLayerNetwork:
                 body, init, (features, labels, features_mask, labels_mask))
             return params, updater_state, net_state, scores
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(multi, name="mln.multi_train_step",
+                                    donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _gather_train_step(self):
@@ -307,7 +311,8 @@ class MultiLayerNetwork:
                 body, init, idx)
             return params, updater_state, net_state, scores
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(multi, name="mln.gather_train_step",
+                                    donate_argnums=(0, 1, 2))
 
     def _fit_device_cached(self, source, epochs: int):
         """One ``fit`` over a device-resident dataset (see
@@ -321,26 +326,41 @@ class MultiLayerNetwork:
 
         data_f, data_l = ingest.device_cached_arrays(self, source._ds)
         replay = ingest.ScoreReplayer(self)
+        iters = _monitor.counter("train_iterations_total",
+                                 "supervised train iterations")
         for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            order = ingest.epoch_order(source)
-            for idx in ingest.epoch_index_batches(order, source._batch):
-                (self.params, self.updater_state, self.net_state,
-                 scores) = self._gather_train_step(
-                    self.params, self.updater_state, self.net_state,
-                    self.iteration, data_f, data_l, jnp.asarray(idx),
-                    self._rng_key)
-                replay.add(self.iteration, scores)
-                self.iteration += idx.shape[0]
-                self.last_batch_size = idx.shape[1]
-            if self.listeners:
-                replay.replay()         # blocks: exact per-step scores
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+            with _monitor.span("fit/epoch", epoch=self.epoch,
+                               path="cache"):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                t0 = time.perf_counter()
+                order = ingest.epoch_order(source)
+                batches = list(ingest.epoch_index_batches(
+                    order, source._batch))
+                _monitor.observe_phase("data", time.perf_counter() - t0)
+                for idx in batches:
+                    t1 = time.perf_counter()
+                    (self.params, self.updater_state, self.net_state,
+                     scores) = self._gather_train_step(
+                        self.params, self.updater_state, self.net_state,
+                        self.iteration, data_f, data_l, jnp.asarray(idx),
+                        self._rng_key)
+                    replay.add(self.iteration, scores)
+                    _monitor.observe_phase("step",
+                                           time.perf_counter() - t1)
+                    iters.inc(idx.shape[0])
+                    self.iteration += idx.shape[0]
+                    self.last_batch_size = idx.shape[1]
+                if self.listeners:
+                    t2 = time.perf_counter()
+                    replay.replay()     # blocks: exact per-step scores
+                    _monitor.observe_phase("listener",
+                                           time.perf_counter() - t2)
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
         replay.finish()
         return self
 
@@ -356,42 +376,54 @@ class MultiLayerNetwork:
         replay = ingest.ScoreReplayer(self)
 
         def dispatch(buf):
+            t0 = time.perf_counter()
             features, labels, fm, lm = ingest.stack_window(buf)
             features = ingest.cast_for_transfer(
                 features, self.conf.conf.compute_dtype)
+            features = jnp.asarray(features)
+            labels = jnp.asarray(labels)
+            fm = None if fm is None else jnp.asarray(fm)
+            lm = None if lm is None else jnp.asarray(lm)
+            t1 = time.perf_counter()
+            _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,
-                self.iteration, jnp.asarray(features),
-                jnp.asarray(labels),
-                None if fm is None else jnp.asarray(fm),
-                None if lm is None else jnp.asarray(lm), self._rng_key)
+                self.iteration, features, labels, fm, lm, self._rng_key)
             replay.add(self.iteration, scores)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
+            _monitor.counter("train_iterations_total",
+                             "supervised train iterations").inc(len(buf))
             self.iteration += len(buf)
             self.last_batch_size = buf[0].num_examples()
 
         for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            buf, sig = [], None
-            for ds in iterator:
-                s = ingest.window_signature(ds)
-                if buf and (s != sig or len(buf) >= window):
+            with _monitor.span("fit/epoch", epoch=self.epoch,
+                               path="window"):
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                buf, sig = [], None
+                for ds in iterator:
+                    s = ingest.window_signature(ds)
+                    if buf and (s != sig or len(buf) >= window):
+                        dispatch(buf)
+                        buf = []
+                    sig = s
+                    buf.append(ds)
+                if buf:
                     dispatch(buf)
-                    buf = []
-                sig = s
-                buf.append(ds)
-            if buf:
-                dispatch(buf)
-            if self.listeners:
-                replay.replay()
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
+                if self.listeners:
+                    t2 = time.perf_counter()
+                    replay.replay()
+                    _monitor.observe_phase("listener",
+                                           time.perf_counter() - t2)
+                for listener in self.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self)
+                self.epoch += 1
         replay.finish()
         return self
 
@@ -427,19 +459,24 @@ class MultiLayerNetwork:
                     "provide masks on all batches or none")
             return jnp.stack([jnp.asarray(get(b)) for b in batches])
 
+        t0 = time.perf_counter()
         features = jnp.stack([jnp.asarray(b.features) for b in batches])
         labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
         fmask = stack_masks(lambda b: b.features_mask)
         lmask = stack_masks(lambda b: b.labels_mask)
+        t1 = time.perf_counter()
+        _monitor.observe_phase("data", t1 - t0)
         (self.params, self.updater_state, self.net_state,
          scores) = self._multi_train_step(
             self.params, self.updater_state, self.net_state, self.iteration,
             features, labels, fmask, lmask, self._rng_key)
+        _monitor.observe_phase("step", time.perf_counter() - t1)
+        _monitor.counter("train_iterations_total",
+                         "supervised train iterations").inc(len(batches))
         self.iteration += len(batches)
         self._score = scores[-1]
         self.last_batch_size = batches[0].num_examples()
-        for listener in self.listeners:
-            listener.iteration_done(self, self.iteration)
+        self._fire_listeners()
         return np.asarray(scores)
 
     def _last_stateful_recurrent(self) -> int:
@@ -527,8 +564,9 @@ class MultiLayerNetwork:
                 return (new_params, new_updater_state, new_state,
                         new_carries, score)
 
-            self._tbptt_step_cache[adv] = jax.jit(
-                step, donate_argnums=(0, 1, 2, 3))
+            self._tbptt_step_cache[adv] = _monitor.watched_jit(
+                step, name=f"mln.tbptt_step_adv{adv}",
+                donate_argnums=(0, 1, 2, 3))
         return self._tbptt_step_cache[adv]
 
     @functools.cached_property
@@ -539,7 +577,7 @@ class MultiLayerNetwork:
                                          features_mask, labels_mask, None,
                                          False)
             return data_loss + self._reg_score(params)
-        return jax.jit(score)
+        return _monitor.watched_jit(score, name="mln.score")
 
     @functools.cached_property
     def _output_fn(self):
@@ -548,7 +586,7 @@ class MultiLayerNetwork:
                                       train=False, rng=None,
                                       mask=features_mask)
             return out
-        return jax.jit(run)
+        return _monitor.watched_jit(run, name="mln.output")
 
     @functools.cached_property
     def _rnn_step_fn(self):
@@ -559,7 +597,7 @@ class MultiLayerNetwork:
                 params, net_state, features, train=False, rng=None,
                 carries=carries)
             return out, new_carries
-        return jax.jit(run)
+        return _monitor.watched_jit(run, name="mln.rnn_step")
 
     # -------------------------------------------------------------- pretrain
     def _pretrain_step(self, i: int):
@@ -596,7 +634,8 @@ class MultiLayerNetwork:
                     params[i], layer.l1_by_param(), layer.l2_by_param())
                 return new_p, new_ustate, score
 
-            self._pretrain_step_cache[i] = jax.jit(step, donate_argnums=(1,))
+            self._pretrain_step_cache[i] = _monitor.watched_jit(
+                step, name=f"mln.pretrain_step_layer{i}", donate_argnums=(1,))
         return self._pretrain_step_cache[i]
 
     def pretrain(self, data, epochs: int = 1) -> "MultiLayerNetwork":
@@ -640,8 +679,7 @@ class MultiLayerNetwork:
                                self._rng_key)
                 self._score = score
                 self.iteration += 1
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                self._fire_listeners()
         return self
 
     # ------------------------------------------------------------------- fit
@@ -688,77 +726,103 @@ class MultiLayerNetwork:
             iterator = data
             batches = None
 
-        if self.conf.pretrain and not self._pretrain_done:
-            if batches is None and not hasattr(iterator, "reset"):
-                # One-shot iterable: materialize so layer-wise pretraining
-                # and the supervised phase each see the full data.
-                batches = list(iterator)
-                iterator = None
-            self.pretrain(batches if batches is not None else iterator)
-            self._pretrain_done = True
-        if not self.conf.backprop:
+        from ..optimize.listeners.listeners import finalize_listeners
+        try:
+            if self.conf.pretrain and not self._pretrain_done:
+                if batches is None and not hasattr(iterator, "reset"):
+                    # One-shot iterable: materialize so layer-wise
+                    # pretraining and the supervised phase each see the
+                    # full data.
+                    batches = list(iterator)
+                    iterator = None
+                self.pretrain(batches if batches is not None else iterator)
+                self._pretrain_done = True
+            if not self.conf.backprop:
+                return self
+
+            if (iterator is not None and ingest != "batch"
+                    and self._solver is None
+                    and self.conf.backprop_type != "tbptt"
+                    and self.conf.conf.num_iterations == 1):
+                from . import ingest as ingest_mod
+                if ingest in ("auto", "cache"):
+                    source = ingest_mod.cacheable_source(iterator)
+                    if source is not None:
+                        return self._fit_device_cached(source, epochs)
+                    if ingest == "cache":
+                        raise ValueError(
+                            "ingest='cache' but the iterator is not "
+                            "device-cacheable (see nn/ingest.py "
+                            "eligibility)")
+                return self._fit_windowed(iterator, epochs, window)
+
+            for _ in range(epochs):
+                with _monitor.span("fit/epoch", epoch=self.epoch,
+                                   path="batch"):
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_start"):
+                            listener.on_epoch_start(self)
+                    it = batches if batches is not None else iterator
+                    if hasattr(it, "reset"):
+                        it.reset()
+                    for ds in it:
+                        self._fit_batch(ds)
+                    for listener in self.listeners:
+                        if hasattr(listener, "on_epoch_end"):
+                            listener.on_epoch_end(self)
+                    self.epoch += 1
             return self
+        finally:
+            finalize_listeners(self.listeners)
 
-        if (iterator is not None and ingest != "batch"
-                and self._solver is None
-                and self.conf.backprop_type != "tbptt"
-                and self.conf.conf.num_iterations == 1):
-            from . import ingest as ingest_mod
-            if ingest in ("auto", "cache"):
-                source = ingest_mod.cacheable_source(iterator)
-                if source is not None:
-                    return self._fit_device_cached(source, epochs)
-                if ingest == "cache":
-                    raise ValueError(
-                        "ingest='cache' but the iterator is not "
-                        "device-cacheable (see nn/ingest.py eligibility)")
-            return self._fit_windowed(iterator, epochs, window)
-
-        for _ in range(epochs):
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self)
-            it = batches if batches is not None else iterator
-            if hasattr(it, "reset"):
-                it.reset()
-            for ds in it:
-                self._fit_batch(ds)
-            for listener in self.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self)
-            self.epoch += 1
-        return self
+    def _fire_listeners(self) -> None:
+        """Per-iteration listener callbacks, timed as the ``listener``
+        phase (they run on the host and may force a device score fetch)."""
+        if not self.listeners:
+            return
+        t0 = time.perf_counter()
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        _monitor.observe_phase("listener", time.perf_counter() - t0)
 
     def _fit_batch(self, ds: DataSet) -> None:
         self.last_batch_size = ds.num_examples()
+        t0 = time.perf_counter()
         features = jnp.asarray(ds.features)
         labels = jnp.asarray(ds.labels)
         fmask = (None if ds.features_mask is None
                  else jnp.asarray(ds.features_mask))
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        _monitor.observe_phase("data", time.perf_counter() - t0)
+        iters = _monitor.counter("train_iterations_total",
+                                 "supervised train iterations")
         if self._solver is not None:
             # line-search solver family (reference Solver.optimize path)
             for _ in range(self.conf.conf.num_iterations):
+                t1 = time.perf_counter()
                 self._score = self._solver.optimize(features, labels,
                                                     fmask, lmask)
+                _monitor.observe_phase("step", time.perf_counter() - t1)
                 self.iteration += 1
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                iters.inc()
+                self._fire_listeners()
             return
         if self.conf.backprop_type == "tbptt":
             for _ in range(self.conf.conf.num_iterations):
                 self._fit_tbptt(features, labels, fmask, lmask)
             return
         for _ in range(self.conf.conf.num_iterations):
+            t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state,
              score) = self._train_step(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fmask, lmask,
                 self._rng_key)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
             self._score = score
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            iters.inc()
+            self._fire_listeners()
 
     def _fit_tbptt(self, features, labels, fmask, lmask) -> None:
         """Slice the time axis into tbptt_fwd_length windows, carrying
@@ -791,14 +855,17 @@ class MultiLayerNetwork:
             l = labels[:, sl]
             fm = None if fmask is None else fmask[:, sl]
             lm = None if lmask is None else lmask[:, sl]
+            t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state, carries,
              score) = self._tbptt_step_for(adv)(
                 self.params, self.updater_state, self.net_state, carries,
                 self.iteration, f, l, fm, lm, self._rng_key)
+            _monitor.observe_phase("step", time.perf_counter() - t1)
             scores.append(score)
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            _monitor.counter("train_iterations_total",
+                             "supervised train iterations").inc()
+            self._fire_listeners()
         self._score = scores[-1] if scores else self._score
 
     def _require_carry_support(self, what: str) -> None:
@@ -906,7 +973,8 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _score_examples_fn(self):
-        @functools.partial(jax.jit, static_argnums=(6,))
+        @functools.partial(_monitor.watched_jit,
+                           name="mln.score_examples", static_argnums=(6,))
         def run(params, net_state, features, labels, features_mask,
                 labels_mask, add_reg):
             per, _ = self._loss_fn(params, net_state, features, labels,

@@ -207,6 +207,25 @@ class ParamAndGradientIterationListener(IterationListener):
         self._last_params = tables
 
 
+def finalize_listeners(listeners) -> None:
+    """Run every listener's end-of-training hooks (``stop()`` then
+    ``flush()`` where present).  ``fit()`` calls this in a ``finally``
+    block so a ``ProfilerListener`` capture opened mid-training is closed
+    even when training ends before ``end_iteration`` or raises, and async
+    ``CheckpointListener`` writes are joined.  Hook exceptions are logged,
+    not raised — finalization must never mask the original fit error."""
+    for listener in listeners or ():
+        for hook in ("stop", "flush"):
+            fn = getattr(listener, hook, None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception:  # pragma: no cover - defensive
+                    logging.getLogger(__name__).warning(
+                        "listener %s.%s() failed during finalization",
+                        type(listener).__name__, hook, exc_info=True)
+
+
 class ProfilerListener(TrainingListener):
     """jax.profiler hookup (SURVEY.md §5 tracing/profiling): capture a
     device trace for iterations ``[start_iteration, end_iteration)`` into

@@ -26,6 +26,7 @@ Adam this differs from grad-averaging, matching the reference exactly).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, List, Optional
 
 import jax
@@ -34,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
 from ..nn.multilayer import MultiLayerNetwork
 
@@ -170,7 +172,8 @@ class ParallelWrapper:
         out_specs = (P(), P("data"), P(), P())
         fn = jax.shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
-        return jax.jit(fn, donate_argnums=(0, 1, 2))
+        return _monitor.watched_jit(fn, name="parallel.step",
+                                    donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------ fit
     def fit(self, iterator, epochs: int = 1) -> "ParallelWrapper":
@@ -200,6 +203,11 @@ class ParallelWrapper:
             # XLA recompile for one step.  Stragglers are counted so callers
             # can size iterators to workers*averaging_frequency.
             self.skipped_tail_batches += len(pending)
+        if self.skipped_tail_batches:
+            _monitor.counter(
+                "parallel_skipped_tail_batches_total",
+                "straggler batches dropped by incomplete averaging "
+                "rounds").inc(self.skipped_tail_batches)
         if rounds_run == 0:
             import warnings
             warnings.warn(
@@ -212,8 +220,14 @@ class ParallelWrapper:
         return self
 
     def _run_round(self, batches: List[DataSet]) -> None:
+        with _monitor.span("parallel/round", workers=self.workers,
+                           steps=self.averaging_frequency):
+            self._run_round_inner(batches)
+
+    def _run_round_inner(self, batches: List[DataSet]) -> None:
         net = self.model
         k, w = self.averaging_frequency, self.workers
+        t0 = time.perf_counter()
         b = min(ds.num_examples() for ds in batches)
 
         def stack(get):
@@ -277,18 +291,30 @@ class ParallelWrapper:
                                                (w,) + a.shape),
                     net.updater_state),
                 NamedSharding(self.mesh, P("data")))
+        t1 = time.perf_counter()
+        _monitor.observe_phase("data", t1 - t0)
         (net.params, self._worker_ustate, net.net_state,
          score) = self._parallel_step(
             net.params, self._worker_ustate, net.net_state,
             net.iteration, feats, labs, fmask, lmask, net._rng_key)
+        _monitor.observe_phase("step", time.perf_counter() - t1)
+        _monitor.counter("parallel_rounds_total",
+                         "parameter-averaging rounds (one pmean sync "
+                         "each)").inc()
+        _monitor.counter("parallel_worker_steps_total",
+                         "per-replica local train steps across all "
+                         "workers").inc(k * w)
         # Keep the model's own updater state in sync (worker 0's replica —
         # identical across workers when average_updaters is on).
         net.updater_state = jax.tree.map(lambda a: a[0], self._worker_ustate)
         net.iteration += k
         net._score = score
         self.last_score = float(score) if self.report_score else None
+        t2 = time.perf_counter()
         for listener in self.listeners + net.listeners:
             listener.iteration_done(net, net.iteration)
+        if self.listeners or net.listeners:
+            _monitor.observe_phase("listener", time.perf_counter() - t2)
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self) -> None:

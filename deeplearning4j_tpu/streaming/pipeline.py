@@ -23,6 +23,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
 from .conversion import RecordConverter
 from .sources import RecordSource
@@ -101,8 +102,15 @@ class StreamingPipeline:
                     feats.append(f)
                     labels.append(l)
                     self.records_processed += 1
+                    _monitor.counter("streaming_records_total",
+                                     "records converted off the "
+                                     "source").inc()
                 except Exception as e:   # poison record: count, continue
                     self.errors.append(e)
+                    _monitor.counter("streaming_errors_total",
+                                     "streaming pipeline errors (poison "
+                                     "records, callback and process "
+                                     "failures)").inc(reason="convert")
             full = len(feats) >= self.batch_size
             stale = feats and (now - last_flush) >= self.flush_interval
             if full or stale:
@@ -116,6 +124,16 @@ class StreamingPipeline:
 
     def _process(self, feats: List[np.ndarray],
                  labels: List[Optional[np.ndarray]]) -> None:
+        with _monitor.span("streaming/batch", records=len(feats)):
+            t0 = time.perf_counter()
+            self._process_inner(feats, labels)
+            _monitor.registry().histogram(
+                "streaming_batch_ms",
+                "end-to-end processing of one streaming micro-batch "
+                "(ms)").observe((time.perf_counter() - t0) * 1e3)
+
+    def _process_inner(self, feats: List[np.ndarray],
+                       labels: List[Optional[np.ndarray]]) -> None:
         n = len(feats)
         x = np.stack(feats)
         # pad to the static micro-batch size: one compiled program
@@ -133,6 +151,11 @@ class StreamingPipeline:
                         self.on_prediction(x, out)
                     except Exception as e:
                         self.errors.append(e)
+                        _monitor.counter(
+                            "streaming_errors_total",
+                            "streaming pipeline errors (poison records, "
+                            "callback and process failures)").inc(
+                                reason="callback")
             if self.mode in ("fit", "both"):
                 have = [i for i, l in enumerate(labels) if l is not None]
                 if have:
@@ -145,6 +168,8 @@ class StreamingPipeline:
                         xf, yf = xf[idx], yf[idx]
                     self.net.fit(DataSet(xf, yf))
             self.batches_processed += 1
+            _monitor.counter("streaming_batches_total",
+                             "streaming micro-batches processed").inc()
             # offset-tracking sources (BrokerRecordSource) commit the
             # processed prefix here: commit-after-process gives the
             # at-least-once resume contract of the reference's
@@ -153,3 +178,7 @@ class StreamingPipeline:
                 self.source.on_batch_processed()
         except Exception as e:
             self.errors.append(e)
+            _monitor.counter("streaming_errors_total",
+                             "streaming pipeline errors (poison records, "
+                             "callback and process failures)").inc(
+                                 reason="process")

@@ -17,6 +17,15 @@ charts, no external assets) and JSON data endpoints the page polls:
     GET  /train/overview/data?sid=  -> score/throughput/lr/memory series
     GET  /train/model/data?sid=     -> per-param magnitudes/ratios/histograms
     POST /remote                    -> Persistable JSON (remote router)
+
+Runtime-telemetry export (the ``monitor`` package's process globals):
+
+    GET  /metrics  -> Prometheus text exposition (counters/gauges/summaries)
+    GET  /trace    -> Chrome trace events, one JSON object per line (wrap
+                      the lines in [...] for Perfetto / chrome://tracing)
+    GET  /healthz  -> liveness probe for scrapers
+
+Unknown routes return 404 with a JSON error body.
 """
 
 from __future__ import annotations
@@ -28,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from .. import monitor as _monitor
 from .storage import (InMemoryStatsStorage, Persistable, StatsStorage,
                       StatsStorageRouter)
 from .stats_listener import TYPE_ID
@@ -294,8 +304,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _TSNE_PAGE.encode(), "text/html")
         elif path == "/tsne/data":
             self._json(ui.tsne_data())
+        elif path == "/metrics":
+            self._send(200, _monitor.prometheus_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/trace":
+            self._send(200, _monitor.trace_jsonl().encode(),
+                       "application/x-ndjson")
+        elif path == "/healthz":
+            self._json({"status": "ok"})
         else:
-            self._send(404, b'{"error": "not found"}')
+            self._send(404, json.dumps(
+                {"error": "not found", "path": path}).encode())
 
     # ---- POST /remote (RemoteUIStatsStorageRouter receiver) + /tsne ------
     def do_POST(self):
@@ -304,7 +323,8 @@ class _Handler(BaseHTTPRequestHandler):
         if path not in ("/remote", "/tsne/upload"):
             # Route before touching the body: unknown paths must 404 even
             # with an empty/non-JSON body.
-            self._send(404, b'{"error": "not found"}')
+            self._send(404, json.dumps(
+                {"error": "not found", "path": path}).encode())
             return
         length = int(self.headers.get("Content-Length", "0"))
         try:

@@ -1,0 +1,318 @@
+"""Monitor-stack tests: tracing spans, the metrics registry, the jit
+compile-watch, listener finalization, and the export paths
+(``GET /metrics`` + ``GET /trace`` + ``GET /healthz`` on the UI server,
+``system_metrics_persistable`` into a StatsStorage)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.monitor.jit_watch import (CACHE_HITS_TOTAL,
+                                                  COMPILES_TOTAL)
+from deeplearning4j_tpu.monitor.metrics import MetricsRegistry
+from deeplearning4j_tpu.monitor.tracing import Tracer
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners.listeners import (
+    TrainingListener, finalize_listeners)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+from deeplearning4j_tpu.ui.stats_listener import TYPE_ID
+
+
+@pytest.fixture(autouse=True)
+def _isolated_monitor():
+    """The registry/tracer are process-global; every call site re-resolves
+    its handles, so reset() before and after keeps tests independent."""
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater("sgd").learning_rate(0.1)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+# ------------------------------------------------------------------ tracing
+
+def test_span_nesting_records_parent_ids():
+    with monitor.span("outer") as outer_id:
+        with monitor.span("inner", depth=1) as inner_id:
+            pass
+    events = {e["name"]: e for e in monitor.tracer().events()}
+    assert events["outer"]["parent"] is None
+    assert events["inner"]["parent"] == outer_id
+    assert events["inner"]["id"] == inner_id
+    assert events["inner"]["attrs"] == {"depth": 1}
+    # children finish first: the ring is ordered by completion time
+    assert events["inner"]["dur_ms"] <= events["outer"]["dur_ms"]
+
+
+def test_tracer_ring_buffer_is_bounded():
+    t = Tracer(capacity=8)
+    for i in range(20):
+        with t.span("s", i=i):
+            pass
+    events = t.events()
+    assert len(events) == 8
+    assert [e["attrs"]["i"] for e in events] == list(range(12, 20))
+
+
+def test_trace_jsonl_is_chrome_event_format():
+    with monitor.span("fit/epoch", epoch=0):
+        pass
+    lines = monitor.trace_jsonl().splitlines()
+    assert lines
+    for line in lines:
+        ev = json.loads(line)
+        assert ev["ph"] == "X"
+        assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(ev)
+    # the documented wrapper is a loadable Chrome/Perfetto trace
+    json.loads("[" + ",".join(lines) + "]")
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_counter_and_gauge_labels():
+    c = monitor.counter("requests_total", "test counter")
+    c.inc()
+    c.inc(2, route="/a")
+    g = monitor.gauge("depth", "test gauge")
+    g.set(3.5, pool="x")
+    g.inc(0.5, pool="x")
+    snap = monitor.snapshot()
+    assert snap["requests_total"]["values"][""] == 1
+    assert snap["requests_total"]["values"]['{route="/a"}'] == 2
+    assert snap["depth"]["values"]['{pool="x"}'] == 4.0
+
+
+def test_histogram_percentiles():
+    h = monitor.histogram("latency_ms", "test histogram")
+    for v in range(1, 101):
+        h.observe(float(v))
+    stats = h.stats()
+    assert stats["count"] == 100
+    assert stats["sum"] == pytest.approx(5050.0)
+    assert stats["min"] == 1.0 and stats["max"] == 100.0
+    assert 49 <= stats["p50"] <= 51
+    assert 94 <= stats["p95"] <= 96
+    assert 98 <= stats["p99"] <= 100
+
+
+def test_prometheus_text_exposition():
+    monitor.counter("c_total", "a counter").inc(3, job="train")
+    monitor.histogram("h_ms", "a histogram").observe(5.0)
+    text = monitor.prometheus_text()
+    assert "# HELP c_total a counter" in text
+    assert "# TYPE c_total counter" in text
+    assert 'c_total{job="train"} 3' in text
+    assert 'h_ms{quantile="0.95"}' in text
+    assert "h_ms_count 1" in text
+
+
+def test_registry_rejects_kind_mismatch():
+    reg = MetricsRegistry()
+    reg.counter("x", "")
+    with pytest.raises(TypeError):
+        reg.gauge("x", "")
+
+
+# -------------------------------------------------------------- jit watch
+
+def test_watched_jit_counts_compiles_and_cache_hits():
+    wj = monitor.watched_jit(lambda x: x + 1, name="probe")
+    x4 = np.zeros((4,), np.float32)
+    wj(x4)
+    wj(x4)
+    wj(x4 + 1)                      # same shape: cache hit
+    assert wj.compile_count == 1
+    wj(np.zeros((8,), np.float32))  # shape churn: recompile
+    assert wj.compile_count == 2
+    snap = monitor.snapshot()
+    assert snap[COMPILES_TOTAL]["values"]['{fn="probe"}'] == 2
+    assert snap[CACHE_HITS_TOTAL]["values"]['{fn="probe"}'] == 2
+    compiles = [e for e in monitor.tracer().events()
+                if e["name"] == "jit/compile/probe"]
+    assert len(compiles) == 2
+    assert compiles[0]["attrs"]["recompile"] is False
+    assert compiles[1]["attrs"]["recompile"] is True
+    assert "float32[8]" in compiles[1]["attrs"]["signature"]
+
+
+def test_watched_jit_python_scalars_do_not_recompile():
+    # jax.jit treats python scalars as weak-typed: a VALUE change does not
+    # retrace, so the watcher must not count one either
+    wj = monitor.watched_jit(lambda x, k: x * k, name="scalar_probe")
+    x = np.ones((2,), np.float32)
+    wj(x, 2.0)
+    wj(x, 3.0)
+    assert wj.compile_count == 1
+
+
+def test_watched_jit_static_argnums_value_recompiles():
+    wj = monitor.watched_jit(lambda x, n: x[:n], name="static_probe",
+                             static_argnums=(1,))
+    x = np.arange(8, dtype=np.float32)
+    wj(x, 2)
+    wj(x, 2)
+    assert wj.compile_count == 1
+    wj(x, 4)                        # static value change IS a retrace
+    assert wj.compile_count == 2
+
+
+def test_watched_jit_aot_lower_compile_is_counted():
+    wj = monitor.watched_jit(lambda x: x * 2, name="aot_probe")
+    compiled = wj.lower(np.ones((4,), np.float32)).compile()
+    out = np.asarray(compiled(np.ones((4,), np.float32)))
+    assert out[0] == 2.0
+    snap = monitor.snapshot()
+    assert snap[COMPILES_TOTAL]["values"]['{fn="aot_probe"}'] == 1
+    # the AOT cache is separate from jit's: lower() must not mark the
+    # signature seen for __call__
+    assert wj.compile_count == 0
+
+
+def test_fit_populates_phases_and_compile_watch():
+    net = _net()
+    snap = monitor.snapshot()
+    net.fit(_data(), epochs=3)
+    bd = monitor.phase_breakdown(since=snap)
+    assert bd["steps"] == 3
+    assert bd["step_ms"] > 0
+    assert bd["compile_ms"] > 0
+    mln = monitor.snapshot()[COMPILES_TOTAL]["values"]
+    # one steady shape -> exactly one compile of the train step
+    assert mln['{fn="mln.train_step"}'] == 1
+
+
+def test_fit_shape_churn_increments_recompiles():
+    net = _net()
+    net.fit(_data(16), epochs=1)
+    base = monitor.snapshot()[COMPILES_TOTAL]["values"]['{fn="mln.train_step"}']
+    net.fit(_data(24), epochs=1)    # ragged batch: new abstract signature
+    snap = monitor.snapshot()
+    assert snap[COMPILES_TOTAL]["values"]['{fn="mln.train_step"}'] == base + 1
+    churn = [e for e in monitor.tracer().events()
+             if e["name"] == "jit/compile/mln.train_step"
+             and e["attrs"].get("recompile")]
+    assert churn and "24" in churn[-1]["attrs"]["signature"]
+
+
+# ------------------------------------------------------ listener finalization
+
+class _Recorder(TrainingListener):
+    def __init__(self, fail=False):
+        self.iterations = 0
+        self.stopped = 0
+        self.flushed = 0
+        self.fail = fail
+
+    def iteration_done(self, model, iteration):
+        self.iterations += 1
+        if self.fail:
+            raise RuntimeError("listener boom")
+
+    def stop(self):
+        self.stopped += 1
+
+    def flush(self):
+        self.flushed += 1
+
+
+def test_fit_finalizes_listeners_on_normal_exit():
+    net = _net()
+    rec = _Recorder()
+    net.add_listener(rec)
+    net.fit(_data(), epochs=2)
+    assert rec.iterations == 2
+    assert rec.stopped == 1 and rec.flushed == 1
+
+
+def test_fit_finalizes_listeners_when_a_listener_raises():
+    net = _net()
+    rec = _Recorder(fail=True)
+    net.add_listener(rec)
+    with pytest.raises(RuntimeError, match="listener boom"):
+        net.fit(_data(), epochs=2)
+    # the profiler-style trace leak: stop()/flush() must still run
+    assert rec.stopped == 1 and rec.flushed == 1
+
+
+def test_finalize_listeners_swallows_hook_failures():
+    class Bad:
+        def stop(self):
+            raise OSError("already closed")
+    finalize_listeners([Bad(), None, object()])   # must not raise
+
+
+# ------------------------------------------------------------- export paths
+
+def test_ui_server_metrics_trace_healthz_and_404():
+    monitor.counter("scrape_probe_total", "endpoint test").inc(7)
+    with monitor.span("export/test"):
+        pass
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "scrape_probe_total 7" in body
+        assert "# TYPE scrape_probe_total counter" in body
+
+        trace = urllib.request.urlopen(base + "/trace").read().decode()
+        names = [json.loads(l)["name"] for l in trace.splitlines()]
+        assert "export/test" in names
+
+        hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert hz == {"status": "ok"}
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/no/such/route")
+        assert err.value.code == 404
+        payload = json.loads(err.value.read())
+        assert payload["error"] == "not found"
+        assert payload["path"] == "/no/such/route"
+    finally:
+        server.stop()
+
+
+def test_system_metrics_persistable_round_trip():
+    net = _net()
+    net.fit(_data(), epochs=2)
+    storage = InMemoryStatsStorage()
+    monitor.post_system_metrics(storage, net, "sess_mon")
+    rec = storage.get_latest_update("sess_mon", TYPE_ID, "monitor_0")
+    assert rec is not None
+    assert rec.data["iteration"] == net.iteration
+    assert rec.data["monitor"]["phases"]["steps"] >= 2
+    assert "phase_step_ms" in rec.data["monitor"]["metrics"]
+
+    server = UIServer(storage, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        ov = json.loads(urllib.request.urlopen(
+            base + "/train/overview/data?sid=sess_mon").read())
+        # the existing overview consumes the record unchanged
+        assert len(ov["score_vs_iter"]) == 1
+        assert ov["score_vs_iter"][0][0] == net.iteration
+    finally:
+        server.stop()

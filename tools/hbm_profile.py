@@ -167,6 +167,22 @@ def compiled_step(config: str):
     return net._multi_train_step.lower(*args).compile()
 
 
+def register_monitor_gauges(config: str, by_class: dict,
+                            total: int) -> None:
+    """Publish the profile into the runtime telemetry registry so a
+    /metrics scrape (ui server) or ``monitor.snapshot()`` carries the
+    per-op-class HBM totals alongside the live training metrics."""
+    from deeplearning4j_tpu import monitor
+    for cls, b in by_class.items():
+        monitor.gauge("hbm_profile_bytes",
+                      "per-op-class HBM bytes per train step (parsed "
+                      "from optimized HLO)").set(float(b), config=config,
+                                                 op_class=cls)
+    monitor.gauge("hbm_profile_total_bytes",
+                  "total parsed HBM bytes per train step").set(
+                      float(total), config=config)
+
+
 def main() -> int:
     config = sys.argv[1] if len(sys.argv) > 1 else "resnet"
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 15
@@ -192,6 +208,7 @@ def main() -> int:
     print("\n# traffic by op class (all instructions)")
     for cls, b in sorted(by_class.items(), key=lambda kv: -kv[1]):
         print(f"{b/1e6:8.1f} MB  {100*b/total:5.1f}%  {cls}")
+    register_monitor_gauges(config, by_class, total)
     return 0
 
 
