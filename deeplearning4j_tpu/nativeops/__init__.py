@@ -130,6 +130,8 @@ def load_native() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
     lib.dl4j_pjrt_cache_clear.restype = ctypes.c_int64
     lib.dl4j_pjrt_cache_clear.argtypes = [ctypes.c_void_p]
+    lib.dl4j_pjrt_cache_evict.restype = ctypes.c_int64
+    lib.dl4j_pjrt_cache_evict.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.dl4j_pjrt_exec_num_outputs.restype = ctypes.c_int
     lib.dl4j_pjrt_exec_num_outputs.argtypes = [ctypes.c_void_p,
                                                ctypes.c_int64]
@@ -300,6 +302,70 @@ def _axon_create_options() -> List[Tuple[str, object]]:
     ]
 
 
+# Probe results per plugin path ("" = default search), cached for the
+# process lifetime: (usable, reason).
+_PLUGIN_PROBE_CACHE: dict = {}
+
+
+def pjrt_plugin_usable(plugin_path: Optional[str] = None,
+                       timeout: float = 90.0) -> Tuple[bool, str]:
+    """Report whether creating a ``PjrtClient`` in this process is safe.
+
+    Some plugins hard-``abort()`` the host process from inside
+    ``PJRT_Client_Create`` when their environment is missing (the axon
+    tunnel plugin check-fails when no TPU system exists) — a failure
+    mode no ``try/except`` can catch.  So the first creation attempt
+    runs in a disposable subprocess; only if that survives does the
+    caller dlopen the plugin in-process.  Results are cached per path
+    for the process lifetime.
+
+    ``DL4J_TPU_PJRT=0`` marks every plugin unusable (native PJRT paths
+    degrade to their JAX equivalents); ``DL4J_TPU_PJRT_PROBE=0`` skips
+    the subprocess and trusts the plugin (production, where the probe's
+    startup cost is unwanted and the environment is known good).
+    """
+    if os.environ.get("DL4J_TPU_PJRT", "").strip() == "0":
+        return False, "disabled via DL4J_TPU_PJRT=0"
+    if os.environ.get("DL4J_TPU_PJRT_PROBE", "").strip() == "0":
+        return True, "probe skipped via DL4J_TPU_PJRT_PROBE=0"
+    key = plugin_path or ""
+    cached = _PLUGIN_PROBE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import subprocess
+    import sys
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, DL4J_TPU_PJRT_PROBE="0",
+               PYTHONPATH=repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    code = ("import sys\n"
+            "from deeplearning4j_tpu.nativeops import PjrtClient\n"
+            "path = sys.argv[1] if len(sys.argv) > 1 else None\n"
+            "c = PjrtClient(path)\n"
+            "print(c.platform_name())\n"
+            "c.close()\n")
+    cmd = [sys.executable, "-c", code]
+    if plugin_path:
+        cmd.append(plugin_path)
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              capture_output=True, text=True)
+        if proc.returncode == 0:
+            result = (True, "ok: %s" % proc.stdout.strip())
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip()
+            result = (False,
+                      "probe subprocess exited %d: %s"
+                      % (proc.returncode, tail[-400:]))
+    except subprocess.TimeoutExpired:
+        result = (False, "probe subprocess timed out after %.0fs" % timeout)
+    except OSError as exc:  # no interpreter / fork failure
+        result = (False, "probe subprocess failed to start: %s" % exc)
+    _PLUGIN_PROBE_CACHE[key] = result
+    return result
+
+
 class PjrtClient:
     """C++ PJRT client handle (``native/pjrt_shim.cc``).  The compute
     path: ``run_mlir`` compiles a textual StableHLO module in C++ and
@@ -314,6 +380,9 @@ class PjrtClient:
                             if os.path.exists(p)])
         if not candidates:
             raise RuntimeError("no PJRT plugin found")
+        usable, reason = pjrt_plugin_usable(plugin_path)
+        if not usable:
+            raise RuntimeError("PJRT plugin unusable: " + reason)
         err = ctypes.create_string_buffer(2048)
         handle = None
         for cand in candidates:
@@ -416,6 +485,14 @@ class PjrtClient:
         safe — pinned entries destroy on completion).  Compiled ids
         become invalid."""
         return int(self._lib.dl4j_pjrt_cache_clear(self._h))
+
+    def cache_evict(self, exec_id: int) -> bool:
+        """Evict one cached executable by id (per-entry LRU support:
+        callers like ``NativeModelRunner`` track recency and evict the
+        coldest entry instead of dropping the whole cache).  In-flight
+        executions finish safely; the id is invalid afterwards.  Returns
+        True if the id was found and evicted."""
+        return bool(self._lib.dl4j_pjrt_cache_evict(self._h, exec_id))
 
     def cache_stats(self) -> dict:
         hits = ctypes.c_int64()
@@ -592,4 +669,5 @@ class PjrtClient:
 
 
 __all__ = ["build_native", "load_native", "idx_decode", "cifar_decode",
-           "NativePrefetcher", "PjrtClient", "DEFAULT_PLUGIN_PATHS"]
+           "NativePrefetcher", "PjrtClient", "DEFAULT_PLUGIN_PATHS",
+           "pjrt_plugin_usable"]

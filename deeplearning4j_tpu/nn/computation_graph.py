@@ -990,6 +990,41 @@ class ComputationGraph:
             self.params, self.net_state, feats, fmasks)]
         return outs[0] if len(outs) == 1 else outs
 
+    def compile_output(self, feature_shapes, dtype=None, mask_shapes=None,
+                       mask_dtype=None, params=None, net_state=None):
+        """AOT-compile the inference forward for one concrete shape per
+        graph input (``.lower().compile()`` through
+        ``monitor.watched_jit`` → counted in
+        ``jit_compiles_total{fn="cg.output"}``); the ``ComputationGraph``
+        face of the serving bucket-warmup primitive — see
+        ``MultiLayerNetwork.compile_output``.
+
+        ``feature_shapes`` is one shape tuple per network input;
+        ``mask_shapes`` (optional) one shape-or-None per input.  Call the
+        result as ``compiled(params, net_state, features_tuple,
+        masks_tuple_or_None)``; it returns the output list.
+        ``params``/``net_state`` override the lowering operands (pass
+        device-committed copies to pin the executable to a device).
+        """
+        self.init()
+        if params is None:
+            params = self.params
+        if net_state is None:
+            net_state = self.net_state
+        dt = jnp.dtype(dtype if dtype is not None else self.conf.conf.dtype)
+        avals = tuple(
+            jax.ShapeDtypeStruct(tuple(int(d) for d in s), dt)
+            for s in feature_shapes)
+        mavals = None
+        if mask_shapes is not None:
+            mdt = jnp.dtype(mask_dtype if mask_dtype is not None else dt)
+            mavals = tuple(
+                None if s is None
+                else jax.ShapeDtypeStruct(tuple(int(d) for d in s), mdt)
+                for s in mask_shapes)
+        return self._output_fn.lower(params, net_state, avals,
+                                     mavals).compile()
+
     def score(self, data=None) -> float:
         if data is None:
             return float(self._score)

@@ -900,6 +900,40 @@ class MultiLayerNetwork:
                               jnp.asarray(features), fmask)
         return np.asarray(out)
 
+    def compile_output(self, feature_shape, dtype=None, mask_shape=None,
+                       mask_dtype=None, params=None, net_state=None):
+        """AOT-compile the inference forward for ONE concrete input shape
+        (``jit(...).lower().compile()`` through ``monitor.watched_jit``,
+        so every warmed shape is counted in
+        ``jit_compiles_total{fn="mln.output"}``).  This is the serving
+        bucket-warmup primitive: the ``serving.InferenceEngine`` compiles
+        one executable per (batch-bucket, timestep-bucket) up front and
+        then dispatches with zero trace/compile work on the hot path.
+
+        Returns the compiled executable; call it as
+        ``compiled(params, net_state, features, features_mask)`` with
+        arrays matching the lowered shapes exactly (pass ``None`` for the
+        mask iff ``mask_shape`` was ``None``).  ``params``/``net_state``
+        override the lowering operands — pass device-committed copies to
+        pin the executable to a specific device (the serving worker-pool
+        path).
+        """
+        self.init()
+        if params is None:
+            params = self.params
+        if net_state is None:
+            net_state = self.net_state
+        dt = jnp.dtype(dtype if dtype is not None else self.conf.conf.dtype)
+        aval = jax.ShapeDtypeStruct(tuple(int(d) for d in feature_shape),
+                                    dt)
+        maval = None
+        if mask_shape is not None:
+            mdt = jnp.dtype(mask_dtype if mask_dtype is not None else dt)
+            maval = jax.ShapeDtypeStruct(
+                tuple(int(d) for d in mask_shape), mdt)
+        return self._output_fn.lower(params, net_state, aval,
+                                     maval).compile()
+
     def feed_forward(self, features) -> List[np.ndarray]:
         """All layer activations (reference ``feedForward:655-747``)."""
         self.init()

@@ -23,7 +23,8 @@ definition and C++ kernel execution.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -55,8 +56,11 @@ class NativeModelRunner:
                             for l in leaves]
         self._buf_ids = [self._client.buffer_from_host(np.asarray(l))
                          for l in leaves]
-        self._execs: Dict[Tuple, int] = {}
+        # insertion/access-ordered: oldest-used first, so hitting
+        # max_shapes evicts exactly the least-recently-used executable
+        self._execs: "OrderedDict[Tuple, int]" = OrderedDict()
         self._max_shapes = int(max_shapes)
+        self.evictions = 0
 
     # ------------------------------------------------------------- compile
     def _exec_for(self, avals) -> int:
@@ -64,6 +68,7 @@ class NativeModelRunner:
         the per-shape analogue of cuDNN descriptor/algo caching)."""
         key = tuple((a.shape, str(a.dtype)) for a in avals)
         if key in self._execs:
+            self._execs.move_to_end(key)  # LRU touch
             return self._execs[key]
 
         if self._is_graph:
@@ -87,22 +92,25 @@ class NativeModelRunner:
         # keep_unused: params not used at inference (e.g. pretrain-only
         # state) must STAY as program operands, or the buffer-id ->
         # operand mapping below would shift
-        if len(self._execs) >= self._max_shapes:
+        while len(self._execs) >= self._max_shapes:
             # bound executable memory under shape churn (the reference's
-            # cuDNN caches are bounded per layer; here per runner)
+            # cuDNN caches are bounded per layer; here per runner) by
+            # evicting the least-recently-used entry only — a steady
+            # working set of <= max_shapes shapes never recompiles
+            _, old_id = self._execs.popitem(last=False)
+            self.evictions += 1
             if self._owns_client:
-                self._client.cache_clear()
+                self._client.cache_evict(old_id)
             else:
                 # a SHARED client may hold other runners' executables —
-                # only drop this runner's references (ids stay valid in
-                # the shared cache until its owner clears it)
+                # only drop this runner's reference (the id stays valid
+                # in the shared cache until its owner clears it)
                 import warnings
                 warnings.warn(
                     "NativeModelRunner hit max_shapes on a shared "
-                    "PjrtClient: dropping local executable refs; the "
-                    "shared cache retains them until its owner calls "
+                    "PjrtClient: dropping the LRU executable ref; the "
+                    "shared cache retains it until its owner calls "
                     "cache_clear()", RuntimeWarning, stacklevel=2)
-            self._execs.clear()
         lowered = jax.jit(fwd, keep_unused=True).lower(self._leaf_avals,
                                                        *avals)
         mlir = lowered.as_text()

@@ -140,7 +140,8 @@ def _pad_to(x: Array, axis: int, multiple: int) -> Array:
 def _sds(shape, dtype, like: Array) -> jax.ShapeDtypeStruct:
     """ShapeDtypeStruct carrying ``like``'s shard_map varying-axes tag
     (required for pallas_call under shard_map with vma checking)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

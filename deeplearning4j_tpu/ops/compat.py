@@ -1,0 +1,53 @@
+"""Version-compat shims for jax APIs newer than the pinned runtime.
+
+The shard_map varying-manual-axes (vma) type system — ``jax.typeof``,
+``lax.pcast`` — only exists on recent jax.  On older versions there is
+no replication-type to align, so the aligning casts are identity and the
+surrounding shard_map code compiles unchanged.  Call sites go through
+these shims instead of feature-testing jax inline.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def pcast(x, axis_name, *, to):
+    """``lax.pcast`` when available, identity otherwise (no vma type
+    system => nothing to cast)."""
+    fn = getattr(lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_name, to=to)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` when available; the ``psum(1, axis)`` spelling
+    otherwise, which constant-folds to the same static size."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` when available; on older jax, the
+    ``jax.experimental.shard_map`` spelling with ``check_rep=False`` —
+    the call sites manage replication explicitly (pmean where averaging
+    is meant), which is exactly what the old replication checker's
+    auto-psum of unvarying-param gradients would silently break.
+    ``check_vma`` is forwarded when the installed jax understands it and
+    dropped otherwise (older jax has no vma checking to disable)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        try:
+            return fn(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+        except TypeError:
+            return fn(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)

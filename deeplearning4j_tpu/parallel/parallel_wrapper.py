@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..ops.compat import pcast as _pcast, shard_map as _shard_map
 
 from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
@@ -133,7 +134,7 @@ class ParallelWrapper:
             # tracking auto-psums gradients taken w.r.t. unvarying params
             # (allreduce-SGD), which is NOT the reference's local-step-then-
             # average semantics.
-            params, net_state = lax.pcast((params, net_state), "data",
+            params, net_state = _pcast((params, net_state), "data",
                                           to="varying")
 
             def one_step(carry, batch):
@@ -158,7 +159,7 @@ class ParallelWrapper:
             params = lax.pmean(params, "data")
             if avg_updaters:
                 updater_state = lax.pmean(updater_state, "data")
-                updater_state = lax.pcast(updater_state, "data",
+                updater_state = _pcast(updater_state, "data",
                                           to="varying")
             net_state = lax.pmean(net_state, "data")
             score = lax.pmean(jnp.mean(scores), "data")
@@ -170,7 +171,7 @@ class ParallelWrapper:
         in_specs = (P(), P("data"), P(), P(), P(None, "data"),
                     P(None, "data"), P(None, "data"), P(None, "data"), P())
         out_specs = (P(), P("data"), P(), P())
-        fn = jax.shard_map(local_round, mesh=mesh, in_specs=in_specs,
+        fn = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
         return _monitor.watched_jit(fn, name="parallel.step",
                                     donate_argnums=(0, 1, 2))
@@ -179,30 +180,70 @@ class ParallelWrapper:
     def fit(self, iterator, epochs: int = 1) -> "ParallelWrapper":
         """Reference ``fit(DataSetIterator):322``: round-robin dispatch of
         minibatches to workers, averaging every ``averaging_frequency``
-        per-worker iterations."""
+        per-worker iterations.
+
+        With ``prefetch_buffer(n) > 0`` the host side of each round
+        (minibatch stacking + ``device_put`` staging) runs on a
+        background thread, up to ``n`` rounds ahead of the round
+        currently executing — round k+1 stages while round k's
+        ``shard_map`` program runs (the reference's ``prefetchSize``
+        MagicQueue role).  ``prefetch_buffer(0)`` restores the fully
+        synchronous path.
+        """
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
         net = self.model
         net.init()
         k, w = self.averaging_frequency, self.workers
         rounds_run = 0
         self.skipped_tail_batches = 0
-        for _ in range(epochs):
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            pending: List[DataSet] = []
-            for ds in iterator:
-                pending.append(ds)
-                if len(pending) == k * w:
-                    self._run_round(pending)
-                    rounds_run += 1
-                    pending = []
-            # Tail: an incomplete round is left unfitted, matching the
-            # reference exactly (``ParallelWrapper.java:150-165`` dispatches
-            # only full worker groups; stragglers never reach a Trainer).
-            # Padding the round with duplicated batches would give tail
-            # examples extra gradient weight; a smaller round would force an
-            # XLA recompile for one step.  Stragglers are counted so callers
-            # can size iterators to workers*averaging_frequency.
-            self.skipped_tail_batches += len(pending)
+        prefetch = max(0, int(self.prefetch_size or 0))
+        executor = (ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pw-prefetch")
+            if prefetch else None)
+        staged: "collections.deque" = collections.deque()
+        try:
+            for _ in range(epochs):
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                pending: List[DataSet] = []
+                for ds in iterator:
+                    pending.append(ds)
+                    if len(pending) == k * w:
+                        if executor is not None:
+                            staged.append(executor.submit(
+                                self._stage_round, pending))
+                            _monitor.gauge(
+                                "parallel_prefetch_depth",
+                                "rounds staged ahead of dispatch").set(
+                                len(staged))
+                            if len(staged) > prefetch:
+                                self._dispatch_staged(staged.popleft())
+                                rounds_run += 1
+                        else:
+                            self._run_round(pending)
+                            rounds_run += 1
+                        pending = []
+                # Tail: an incomplete round is left unfitted, matching the
+                # reference exactly (``ParallelWrapper.java:150-165``
+                # dispatches only full worker groups; stragglers never
+                # reach a Trainer).  Padding the round with duplicated
+                # batches would give tail examples extra gradient weight;
+                # a smaller round would force an XLA recompile for one
+                # step.  Stragglers are counted so callers can size
+                # iterators to workers*averaging_frequency.
+                self.skipped_tail_batches += len(pending)
+            while staged:
+                self._dispatch_staged(staged.popleft())
+                rounds_run += 1
+        finally:
+            # on error, surface staged rounds' exceptions but never leak
+            # the prefetch thread
+            while staged:
+                staged.popleft().cancel()
+            if executor is not None:
+                executor.shutdown(wait=True)
         if self.skipped_tail_batches:
             _monitor.counter(
                 "parallel_skipped_tail_batches_total",
@@ -222,9 +263,22 @@ class ParallelWrapper:
     def _run_round(self, batches: List[DataSet]) -> None:
         with _monitor.span("parallel/round", workers=self.workers,
                            steps=self.averaging_frequency):
-            self._run_round_inner(batches)
+            self._dispatch_round(self._stage_round(batches))
 
-    def _run_round_inner(self, batches: List[DataSet]) -> None:
+    def _dispatch_staged(self, future) -> None:
+        """Dispatch one background-staged round (prefetch path): block on
+        the staging future, then run the shard_map program."""
+        with _monitor.span("parallel/round", workers=self.workers,
+                           steps=self.averaging_frequency, prefetched=True):
+            self._dispatch_round(future.result())
+
+    def _stage_round(self, batches: List[DataSet]):
+        """Host side of a round: stack the k*w minibatches into the
+        (k, w, b, ...) layout and stage them onto the mesh with
+        ``device_put``.  Runs on the prefetch thread when
+        ``prefetch_size > 0`` — overlapping the previous round's device
+        compute — and returns the staged pytrees for
+        ``_dispatch_round``."""
         net = self.model
         k, w = self.averaging_frequency, self.workers
         t0 = time.perf_counter()
@@ -282,6 +336,16 @@ class ParallelWrapper:
             fmask = jax.device_put(jax.tree.map(jnp.asarray, fmask), sharding)
         if lmask is not None:
             lmask = jax.device_put(jax.tree.map(jnp.asarray, lmask), sharding)
+        _monitor.observe_phase("data", time.perf_counter() - t0)
+        return feats, labs, fmask, lmask
+
+    def _dispatch_round(self, staged) -> None:
+        """Device side of a round: run the fused local-steps + pmean
+        shard_map program on an already-staged round and fold the results
+        back into the model."""
+        net = self.model
+        k, w = self.averaging_frequency, self.workers
+        feats, labs, fmask, lmask = staged
         if self._worker_ustate is None:
             # Replicate the model's updater state to every worker (the
             # reference's per-worker model replication at Trainer start).
@@ -292,7 +356,6 @@ class ParallelWrapper:
                     net.updater_state),
                 NamedSharding(self.mesh, P("data")))
         t1 = time.perf_counter()
-        _monitor.observe_phase("data", t1 - t0)
         (net.params, self._worker_ustate, net.net_state,
          score) = self._parallel_step(
             net.params, self._worker_ustate, net.net_state,

@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..ops.compat import pcast as _pcast, shard_map as _shard_map
 
 from ..datasets.dataset import DataSet
 from ..nn import updaters as U
@@ -130,7 +131,7 @@ class ZeroShardedParallelWrapper:
             # varying params -> per-replica grads + EXPLICIT pmean below
             # (unvarying params would make shard_map auto-psum the grads,
             # i.e. SUM not MEAN — the ParallelWrapper pattern)
-            params, net_state = lax.pcast((params, net_state), "data",
+            params, net_state = _pcast((params, net_state), "data",
                                           to="varying")
             widx = lax.axis_index("data")
             rng = jax.random.fold_in(rng, widx)    # decorrelate dropout
@@ -186,7 +187,7 @@ class ZeroShardedParallelWrapper:
             new_state = jax.tree.map(lambda a: a[None], new_state)
             return new_slice, new_state, new_net_state, score
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             zero_step, mesh=self.mesh,
             in_specs=(P(), P("data"), P(), P(), P("data"), P("data"),
                       P("data"), P("data"), P()),

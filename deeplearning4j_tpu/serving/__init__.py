@@ -1,0 +1,15 @@
+"""Dynamic-batching inference serving (see docs/SERVING.md).
+
+``InferenceEngine`` coalesces concurrent ``predict()`` calls into
+bucket-shaped batches executed by AOT-compiled per-bucket executables;
+``BucketPolicy`` owns the (batch, timestep) ladder both the JAX and
+native PJRT backends share.
+"""
+
+from .bucketing import (BucketPolicy, assemble_batch, batch_ladder,
+                        pad_rows, pad_time, time_mask)
+from .engine import InferenceEngine, QueueFull, ServingError
+
+__all__ = ["BucketPolicy", "InferenceEngine", "QueueFull", "ServingError",
+           "assemble_batch", "batch_ladder", "pad_rows", "pad_time",
+           "time_mask"]

@@ -25,6 +25,18 @@ Runtime-telemetry export (the ``monitor`` package's process globals):
                       the lines in [...] for Perfetto / chrome://tracing)
     GET  /healthz  -> liveness probe for scrapers
 
+Model serving (the ``serving`` package's dynamic-batching engine):
+
+    POST /predict  -> JSON in/out inference against an attached
+                      :class:`~deeplearning4j_tpu.serving.InferenceEngine`
+                      (``attach_inference``).  Body:
+                      ``{"features": [[...], ...]}`` for single-input
+                      models or ``{"inputs": [[[...]], ...]}`` for
+                      multi-input graphs; optional ``"engine"`` (name)
+                      and ``"timeout"`` (seconds).  429 when the engine's
+                      bounded queue rejects the request, 400 on malformed
+                      shapes, 503 when no engine is attached.
+
 Unknown routes return 404 with a JSON error body.
 """
 
@@ -316,10 +328,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
 
+    # ---- POST /predict (dynamic-batching inference) ----------------------
+    def _predict(self, ui: "UIServer") -> None:
+        import numpy as _np
+        from ..serving.engine import QueueFull, ServingError
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            payload = json.loads(self.rfile.read(length).decode())
+        except Exception as e:
+            self._send(400, json.dumps({"error": repr(e)}).encode())
+            return
+        engine = ui.get_inference(payload.get("engine"))
+        if engine is None:
+            self._send(503, json.dumps(
+                {"error": "no inference engine attached",
+                 "engine": payload.get("engine")}).encode())
+            return
+        try:
+            if "inputs" in payload:
+                feats = tuple(_np.asarray(a) for a in payload["inputs"])
+            elif "features" in payload:
+                feats = _np.asarray(payload["features"])
+            else:
+                raise ValueError("body needs 'features' or 'inputs'")
+            timeout = payload.get("timeout")
+            out = engine.predict(
+                feats, timeout=float(timeout) if timeout else None)
+        except QueueFull as e:
+            self._send(429, json.dumps({"error": str(e)}).encode())
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, json.dumps({"error": str(e)}).encode())
+            return
+        except ServingError as e:
+            self._send(503, json.dumps({"error": str(e)}).encode())
+            return
+        if isinstance(out, (list, tuple)):
+            body = {"outputs": [_np.asarray(o).tolist() for o in out]}
+        else:
+            body = {"output": _np.asarray(out).tolist()}
+        self._json(body)
+
     # ---- POST /remote (RemoteUIStatsStorageRouter receiver) + /tsne ------
     def do_POST(self):
         ui: "UIServer" = self.server.ui            # type: ignore
         path = urlparse(self.path).path.rstrip("/")
+        if path == "/predict":
+            self._predict(ui)
+            return
         if path not in ("/remote", "/tsne/upload"):
             # Route before touching the body: unknown paths must 404 even
             # with an empty/non-JSON body.
@@ -358,10 +414,31 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._tsne: dict = {"coords": [], "labels": None}
+        self._engines: dict = {}
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
         return self
+
+    # ---- serving (POST /predict) -----------------------------------------
+    def attach_inference(self, engine, name: Optional[str] = None
+                         ) -> "UIServer":
+        """Register a :class:`~deeplearning4j_tpu.serving.InferenceEngine`
+        behind ``POST /predict``.  The first attached engine is the
+        default; requests may select others by ``{"engine": name}``."""
+        self._engines[name or getattr(engine, "name", "default")] = engine
+        return self
+
+    def detach_inference(self, name: str) -> "UIServer":
+        self._engines.pop(name, None)
+        return self
+
+    def get_inference(self, name: Optional[str] = None):
+        if name is not None:
+            return self._engines.get(name)
+        if self._engines:
+            return next(iter(self._engines.values()))
+        return None
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> "UIServer":

@@ -509,6 +509,48 @@ int64_t dl4j_pjrt_cache_clear(void* handle) {
   return n;
 }
 
+// Evict one cached executable by id (the LRU policy lives in the
+// Python caller — `NativeModelRunner` — the shim only provides
+// per-entry destruction).  The id is unlinked from its hash bucket so
+// lookups can never return it again; an entry pinned by an in-flight
+// execution is marked dead and destroyed on its last unpin.  Returns 1
+// if the id was found and evicted, 0 if unknown or already dead.
+int64_t dl4j_pjrt_cache_evict(void* handle, int64_t exec_id) {
+  ShimClient* shim = static_cast<ShimClient*>(handle);
+  std::lock_guard<std::mutex> lock(shim->mu);
+  auto it = shim->execs.find(exec_id);
+  if (it == shim->execs.end() || it->second.dead) return 0;
+  // recompute the bucket hash from the stored key_text
+  // (program text ‖ '\0' ‖ compile options — the same recipe
+  // dl4j_pjrt_compile_cached hashes with)
+  const std::string& kt = it->second.key_text;
+  size_t p = kt.find('\0');
+  if (p == std::string::npos) p = kt.size();
+  uint64_t key = fnv1a(kt.data(), p);
+  if (kt.size() > p + 1) {
+    key = fnv1a(kt.data() + p + 1, kt.size() - p - 1, key);
+  }
+  auto bit = shim->cache.find(key);
+  if (bit != shim->cache.end()) {
+    std::vector<int64_t>& ids = bit->second;
+    for (size_t i = 0; i < ids.size();) {
+      if (ids[i] == exec_id) {
+        ids.erase(ids.begin() + (ptrdiff_t)i);
+      } else {
+        ++i;
+      }
+    }
+    if (ids.empty()) shim->cache.erase(bit);
+  }
+  if (it->second.pins == 0) {
+    destroy_exec_entry(shim->api, it->second);
+    shim->execs.erase(it);
+  } else {
+    it->second.dead = true;
+  }
+  return 1;
+}
+
 int dl4j_pjrt_cache_stats(void* handle, int64_t* hits, int64_t* misses,
                           int64_t* entries) {
   ShimClient* shim = static_cast<ShimClient*>(handle);

@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from deeplearning4j_tpu.ops.compat import shard_map as _shard_map
 
 from deeplearning4j_tpu.ops.attention import flash_attention
 from deeplearning4j_tpu.parallel.sequence import (SequenceParallel,
@@ -159,7 +160,7 @@ def test_ring_flash_attention_matches_full(causal):
     from deeplearning4j_tpu.parallel.sequence import ring_flash_attention
     q, k, v = _qkv(t=32, h=2, d=16)
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_shard_map(
         functools.partial(ring_flash_attention, axis_name="seq",
                           causal=causal, block_q=8, block_k=8),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
@@ -179,7 +180,7 @@ def test_ring_flash_gradients_match_full(causal):
     from deeplearning4j_tpu.parallel.sequence import ring_flash_attention
     q, k, v = _qkv(t=16, h=2, d=8)
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
-    rf = jax.shard_map(
+    rf = _shard_map(
         functools.partial(ring_flash_attention, axis_name="seq",
                           causal=causal, block_q=8, block_k=8),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
