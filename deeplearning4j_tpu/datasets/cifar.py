@@ -38,19 +38,23 @@ LABELS = ["airplane", "automobile", "bird", "cat", "deer",
           "dog", "frog", "horse", "ship", "truck"]
 
 
-def _read_cifar_bin(path: str, max_records: Optional[int] = None
-                    ) -> Tuple[np.ndarray, np.ndarray]:
-    """Parse one CIFAR-10 binary batch file: records of
-    ``[label u8][3072 x u8 pixels, planar RGB]`` (the layout
-    ``CifarDataFetcher`` reads).  Decodes natively (dataloader.cc) when
-    the C++ tier is available."""
+def _read_cifar_bin_u8(path: str, max_records: Optional[int] = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one CIFAR-10 binary batch file into raw (NHWC uint8 images,
+    int labels): records of ``[label u8][3072 x u8 pixels, planar RGB]``
+    (the layout ``CifarDataFetcher`` reads).  Decodes natively
+    (dataloader.cc) when the C++ tier is available; the native decoder
+    emits [0,1] floats, which round-trip exactly back to the source
+    bytes (the per-value relative error of ``u8 * (1/255f) * 255`` is
+    ~2^-24, far inside the 0.5 rounding margin)."""
     from .native_io import native_module
     native = native_module()
     if native is not None:
         imgs, labels = native.cifar_decode(path)
         if max_records is not None:
             imgs, labels = imgs[:max_records], labels[:max_records]
-        return imgs, labels.astype(np.int64)
+        return (np.rint(imgs * 255.0).astype(np.uint8),
+                labels.astype(np.int64))
     raw = np.fromfile(path, dtype=np.uint8)
     rec = 1 + CHANNELS * HEIGHT * WIDTH
     n = raw.size // rec
@@ -59,9 +63,18 @@ def _read_cifar_bin(path: str, max_records: Optional[int] = None
     raw = raw[:n * rec].reshape(n, rec)
     labels = raw[:, 0].astype(np.int64)
     # planar (C,H,W) -> NHWC
-    imgs = (raw[:, 1:].reshape(n, CHANNELS, HEIGHT, WIDTH)
-            .transpose(0, 2, 3, 1).astype(np.float32) / 255.0)
+    imgs = np.ascontiguousarray(
+        raw[:, 1:].reshape(n, CHANNELS, HEIGHT, WIDTH)
+        .transpose(0, 2, 3, 1))
     return imgs, labels
+
+
+def _read_cifar_bin(path: str, max_records: Optional[int] = None
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """(NHWC float32 [0,1] images, int labels) — the uint8 reader scaled
+    by the canonical ``/255`` (``normalizers.U8_PIXEL``)."""
+    imgs, labels = _read_cifar_bin_u8(path, max_records)
+    return imgs.astype(np.float32) / 255.0, labels
 
 
 def _load_real(data_dir: str, train: bool,
@@ -112,19 +125,22 @@ def _render_class(cls: int, rng: np.random.RandomState) -> np.ndarray:
 
 
 def _generate_synthetic(num: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(NHWC uint8 images, one-hot labels).  Pixels quantize to uint8 at
+    generation — real CIFAR is 8-bit, and the uint8 source is what the
+    ingest wire ships at 1 byte/pixel (``nn/ingest.py``)."""
     rng = np.random.RandomState(seed)
-    x = np.empty((num, HEIGHT, WIDTH, CHANNELS), np.float32)
+    x = np.empty((num, HEIGHT, WIDTH, CHANNELS), np.uint8)
     y = np.zeros((num, NUM_CLASSES), np.float32)
     classes = rng.randint(0, NUM_CLASSES, num)
     for i, c in enumerate(classes):
-        x[i] = _render_class(int(c), rng)
+        x[i] = np.round(_render_class(int(c), rng) * 255.0).astype(np.uint8)
         y[i, c] = 1.0
     return x, y
 
 
-def cifar_arrays(train: bool = True, num_examples: int = 50000,
-                 seed: int = 12) -> Tuple[np.ndarray, np.ndarray]:
-    """(NHWC images in [0,1], one-hot labels): real binary batches if
+def cifar_arrays_u8(train: bool = True, num_examples: int = 50000,
+                    seed: int = 12) -> Tuple[np.ndarray, np.ndarray]:
+    """(NHWC uint8 images, one-hot labels): real binary batches if
     present, else the deterministic procedural set."""
     data_dir = os.environ.get(
         "CIFAR_DIR", os.path.expanduser("~/.deeplearning4j_tpu/cifar10"))
@@ -135,12 +151,25 @@ def cifar_arrays(train: bool = True, num_examples: int = 50000,
     return _generate_synthetic(num_examples, seed + offset)
 
 
+def cifar_arrays(train: bool = True, num_examples: int = 50000,
+                 seed: int = 12) -> Tuple[np.ndarray, np.ndarray]:
+    """(NHWC float32 images in [0,1], one-hot labels) — the uint8 source
+    scaled by the canonical ``/255`` (``normalizers.U8_PIXEL``)."""
+    x, y = cifar_arrays_u8(train, num_examples, seed)
+    return x.astype(np.float32) / 255.0, y
+
+
 class CifarDataSetIterator(ListDataSetIterator):
     """Reference signature ``CifarDataSetIterator(batch, numExamples,
     train)`` (``CifarDataSetIterator.java``).  Emits NHWC [0,1] features;
-    pair with ``InputType.convolutional(32, 32, 3)``."""
+    pair with ``InputType.convolutional(32, 32, 3)``.  Batches carry a
+    uint8 wire twin (``dataset.attach_wire``) for the ingest paths."""
 
     def __init__(self, batch: int, num_examples: int = 50000,
                  train: bool = True, shuffle: bool = True, seed: int = 12):
-        x, y = cifar_arrays(train, num_examples, seed)
-        super().__init__(DataSet(x, y), batch, shuffle, seed)
+        from .dataset import attach_wire
+        from .normalizers import U8_PIXEL
+        u8, y = cifar_arrays_u8(train, num_examples, seed)
+        x = u8.astype(np.float32) / 255.0
+        super().__init__(attach_wire(DataSet(x, y), u8, U8_PIXEL),
+                         batch, shuffle, seed)

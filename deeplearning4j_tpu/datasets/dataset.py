@@ -9,6 +9,7 @@ jitted train step and sharded with ``jax.sharding`` without conversion.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -53,6 +54,33 @@ class DataSet:
             sl = slice(start, min(start + batch_size, n))
             yield DataSet(*[None if a is None else a[sl]
                             for a in self.as_tuple()])
+
+
+def attach_wire(ds: "DataSet", u8: np.ndarray, fmt) -> "DataSet":
+    """Attach a uint8 wire twin to ``ds``: ``u8`` holds the same examples
+    as ``ds.features`` in uint8, ``fmt`` is the
+    :class:`~..normalizers.WireFormat` whose decode reproduces
+    ``ds.features`` bit-exactly.  Carried as an instance attribute (not a
+    dataclass field) so every existing (features, labels, masks) consumer
+    is untouched; ``dataclasses.replace`` copies — e.g. the preprocessor
+    path — deliberately DROP it, since a preprocessed batch no longer
+    matches the wire decode."""
+    ds._wire = (np.asarray(u8), fmt)
+    return ds
+
+
+def wire_of(ds) -> Optional[Tuple[np.ndarray, object]]:
+    """The (uint8 buffer, WireFormat) twin attached by a reader, or
+    None."""
+    return getattr(ds, "_wire", None)
+
+
+def wire_enabled() -> bool:
+    """Whether the uint8 wire may be used for host→device staging.
+    ``DL4J_TPU_WIRE_UINT8=0`` forces the float32 wire everywhere — the
+    escape hatch (and the control arm of the parity tests).  Read at
+    each staging decision, not cached, so tests can flip it."""
+    return os.environ.get("DL4J_TPU_WIRE_UINT8", "1") != "0"
 
 
 @dataclasses.dataclass

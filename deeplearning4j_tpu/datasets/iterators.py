@@ -23,7 +23,7 @@ from typing import Iterable, Iterator, List, Optional
 
 import numpy as np
 
-from .dataset import DataSet
+from .dataset import DataSet, attach_wire, wire_enabled, wire_of
 
 
 class DataSetIterator:
@@ -99,7 +99,14 @@ class ListDataSetIterator(DataSetIterator):
         def _take(a):
             return None if a is None else np.asarray(a)[idx]
 
-        return self._pre(DataSet(*[_take(a) for a in self._ds.as_tuple()]))
+        batch = DataSet(*[_take(a) for a in self._ds.as_tuple()])
+        wire = wire_of(self._ds)
+        if wire is not None:
+            # slice the uint8 twin with the same rows; a preprocessor (if
+            # any) drops it again in _pre, since preprocessed features no
+            # longer match the wire decode
+            attach_wire(batch, wire[0][idx], wire[1])
+        return self._pre(batch)
 
 
 class ExistingDataSetIterator(DataSetIterator):
@@ -173,6 +180,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._native_pf = None
         self._native_left = 0
         self._ring_epoch = 0
+        self._ring_wire = None
         self._use_native_req = True if use_native is None else use_native
         self.native = self._use_native_req and self._native_eligible()
 
@@ -211,8 +219,24 @@ class AsyncDataSetIterator(DataSetIterator):
         from .native_io import native_module
         if self._native_pf is None:
             u = self._under
+            feats_src = np.asarray(u._ds.features, np.float32)
+            self._ring_wire = None
+            wire = wire_of(u._ds)
+            if wire is not None and wire_enabled():
+                u8, fmt = wire
+                flat = np.ascontiguousarray(u8.reshape(u8.shape[0], -1))
+                if flat.shape[1] % 4 == 0:
+                    # Ship bytes through the float32 ring by viewing each
+                    # uint8 row as D/4 "floats": the ring's row gathers
+                    # are dtype-blind, so the permuted rows view back to
+                    # the exact source bytes.  4x less ring memory, and
+                    # the consumer gets the uint8 wire attached for
+                    # device-side decode.
+                    feats_src = flat.view(np.float32)
+                    self._ring_wire = (fmt, u8.shape[1:],
+                                       np.asarray(u._ds.features).shape[1:])
             self._native_pf = native_module().NativePrefetcher(
-                np.asarray(u._ds.features, np.float32),
+                feats_src,
                 np.asarray(u._ds.labels, np.float32),
                 batch=u._batch, capacity=max(2, self._size),
                 seed=u._seed + self._ring_epoch)
@@ -222,6 +246,13 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration
         self._native_left -= 1
         feats, labels = self._native_pf.next()
+        if self._ring_wire is not None:
+            fmt, u8_shape, f_shape = self._ring_wire
+            u8 = feats.view(np.uint8).reshape((feats.shape[0],) + u8_shape)
+            batch = DataSet(
+                fmt.decode_host(u8).reshape((feats.shape[0],) + f_shape),
+                labels)
+            return self._pre(attach_wire(batch, u8, fmt))
         return self._pre(DataSet(feats, labels))
 
     def _worker(self) -> None:

@@ -77,12 +77,17 @@ def _render_digit(digit: int, rng: np.random.RandomState) -> np.ndarray:
 
 
 def _generate_synthetic(num: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(uint8 images, one-hot labels).  Pixels are quantized to uint8 at
+    generation — real MNIST is 8-bit, and a uint8 source is what lets
+    the ingest wire ship 1 byte/pixel with the ``/255`` scale fused into
+    the device program (``nn/ingest.py``)."""
     rng = np.random.RandomState(seed)
-    images = np.empty((num, 784), np.float32)
+    images = np.empty((num, 784), np.uint8)
     labels = np.zeros((num, 10), np.float32)
     digits = rng.randint(0, 10, num)
     for i, d in enumerate(digits):
-        images[i] = _render_digit(int(d), rng).ravel()
+        images[i] = np.round(
+            _render_digit(int(d), rng).ravel() * 255.0).astype(np.uint8)
         labels[i, d] = 1.0
     return images, labels
 
@@ -104,14 +109,18 @@ def _read_idx(path: str) -> np.ndarray:
 
 
 def _decode_idx_images(path: str, num: int) -> np.ndarray:
-    """(n, rows*cols) float32 in [0,1]: native decoder when the C++ tier
-    is available and the file is raw IDX, Python reader otherwise."""
+    """(n, rows*cols) uint8 raw pixels: native decoder when the C++ tier
+    is available and the file is raw IDX, Python reader otherwise.  Both
+    paths emit the identical uint8 payload; the float32 ``/255`` scaling
+    happens in ONE place (``mnist_arrays``) so the uint8 ingest wire's
+    fused on-device decode is bit-exact against it."""
     from .native_io import native_module
     native = native_module()
     if native is not None and not path.endswith(".gz"):
-        dec = native.idx_decode(path, normalize=True)
-        return dec[:num].reshape(min(num, dec.shape[0]), -1)
-    return _read_idx(path)[:num].astype(np.float32) / 255.0
+        dec = native.idx_decode(path, normalize=False)
+        return dec[:num].reshape(
+            min(num, dec.shape[0]), -1).astype(np.uint8)
+    return _read_idx(path)[:num]
 
 
 def _decode_idx_labels(path: str, num: int) -> np.ndarray:
@@ -138,10 +147,11 @@ def _load_real(data_dir: str, train: bool,
     return None
 
 
-def mnist_arrays(train: bool = True, num_examples: int = 60000,
-                 seed: int = 6) -> Tuple[np.ndarray, np.ndarray]:
-    """Raw (features, one-hot labels) arrays: real IDX files if present,
-    else the deterministic procedural set (see module docstring)."""
+def mnist_arrays_u8(train: bool = True, num_examples: int = 60000,
+                    seed: int = 6) -> Tuple[np.ndarray, np.ndarray]:
+    """(uint8 images, one-hot labels): real IDX files if present, else
+    the deterministic procedural set (see module docstring) — the wire
+    form the ingest paths ship at 1 byte/pixel."""
     data_dir = os.environ.get(
         "MNIST_DIR", os.path.expanduser("~/.deeplearning4j_tpu/mnist"))
     real = _load_real(data_dir, train, num_examples)
@@ -151,17 +161,38 @@ def mnist_arrays(train: bool = True, num_examples: int = 60000,
     return _generate_synthetic(num_examples, seed + offset)
 
 
+def mnist_arrays(train: bool = True, num_examples: int = 60000,
+                 seed: int = 6) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw (float32 [0,1] features, one-hot labels) arrays — the uint8
+    source scaled by the canonical ``/255`` (``normalizers.U8_PIXEL``)."""
+    images, labels = mnist_arrays_u8(train, num_examples, seed)
+    return images.astype(np.float32) / 255.0, labels
+
+
 class MnistDataSetIterator(ListDataSetIterator):
     """Reference signature:
     ``MnistDataSetIterator(batch, numExamples, binarize, train, shuffle,
     seed)``.  Features are flat 784-vectors in [0,1] (the reference's
     row-flattened images); pair with ``InputType.convolutionalFlat(28,28,1)``
-    for CNNs."""
+    for CNNs.  Batches carry a uint8 wire twin (``dataset.attach_wire``)
+    so the ingest paths can ship 1 byte/pixel and fuse the ``/255`` into
+    the device program."""
 
     def __init__(self, batch: int, num_examples: int = 60000,
                  binarize: bool = False, train: bool = True,
                  shuffle: bool = True, seed: int = 6):
-        images, labels = mnist_arrays(train, num_examples, seed)
+        from .dataset import attach_wire
+        from .normalizers import U8_PIXEL, WireFormat
+        u8, labels = mnist_arrays_u8(train, num_examples, seed)
         if binarize:
-            images = (images > 0.3).astype(np.float32)
-        super().__init__(DataSet(images, labels), batch, shuffle, seed)
+            # threshold on the scaled value (u8/255 > 0.3 == u8 >= 77);
+            # the {0, 1} result is exactly uint8-representable, so the
+            # wire format degrades to the identity cast.
+            u8 = (u8 >= 77).astype(np.uint8)
+            images = u8.astype(np.float32)
+            fmt = WireFormat()
+        else:
+            images = u8.astype(np.float32) / 255.0
+            fmt = U8_PIXEL
+        super().__init__(attach_wire(DataSet(images, labels), u8, fmt),
+                         batch, shuffle, seed)

@@ -20,9 +20,63 @@ time-series (batch, time, features) inputs all normalise per feature, with
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFormat:
+    """Affine decode spec for a uint8 feature buffer shipped over the
+    host->device wire: ``f32 = float32(u8) / denom * mult + add``.
+
+    The op ORDER and dtypes are the contract — every host producer in
+    ``datasets/`` computes its float32 features with exactly this
+    expression (IEEE round-to-nearest-even at each step), so the fused
+    on-device decode in the train step reproduces the host float32 path
+    bit-for-bit and the bf16 compute cast that follows sees identical
+    inputs on both wires.  Instances cover:
+
+    - readers' ``u8 / 255`` pixel scaling: ``WireFormat(denom=255.0)``;
+    - ``ImagePreProcessingScaler`` (``x / max_pixel * (b - a) + a``):
+      ``WireFormat(denom=max_pixel, mult=b - a, add=a)``;
+    - raw integer payloads (binarized {0,1} pixels): the identity
+      default — dividing by 1.0, multiplying by 1.0 and adding 0.0 are
+      all exact for the non-negative values a u8 cast produces, so the
+      three ops are applied unconditionally on device (no data-dependent
+      program shape).
+    """
+
+    denom: float = 1.0
+    mult: float = 1.0
+    add: float = 0.0
+
+    def decode_host(self, u8: np.ndarray) -> np.ndarray:
+        """Host (numpy) twin of the on-device decode — same expression,
+        same f32 rounding at each op."""
+        x = np.asarray(u8, np.float32)
+        return x / np.float32(self.denom) * np.float32(self.mult) \
+            + np.float32(self.add)
+
+    def as_tuple(self):
+        return (self.denom, self.mult, self.add)
+
+
+#: The readers' canonical pixel format: features = u8 / 255.
+U8_PIXEL = WireFormat(denom=255.0)
+
+
+def wire_format_of(normalizer) -> Optional[WireFormat]:
+    """WireFormat replicating ``normalizer.transform`` on u8 input, or
+    None when the transform is not an affine-on-u8 (only the stateless
+    :class:`ImagePreProcessingScaler` qualifies — statistics-bearing
+    normalizers depend on fitted per-feature arrays)."""
+    if isinstance(normalizer, ImagePreProcessingScaler):
+        return WireFormat(denom=normalizer.max_pixel,
+                          mult=normalizer.b - normalizer.a,
+                          add=normalizer.a)
+    return None
 
 
 def _moments_axes(features: np.ndarray) -> tuple:

@@ -127,6 +127,20 @@ class Evaluation:
     def eval_time_series(self, labels, predictions, mask=None) -> None:
         self.eval(labels, predictions, mask)
 
+    def eval_class_indices(self, actual, predicted, num_classes: int) -> None:
+        """Accumulate a batch from precomputed class indices — the
+        device-side argmax fast path (``do_evaluation`` transfers int32
+        class indices instead of full logit matrices).  Only valid for
+        top_n == 1: index streams cannot recover top-N membership."""
+        if self.top_n > 1:
+            raise ValueError(
+                "class-index evaluation cannot compute top-N accuracy "
+                f"(top_n={self.top_n}); use eval() with full predictions")
+        self._ensure(num_classes)
+        actual = np.asarray(actual).reshape(-1)
+        predicted = np.asarray(predicted).reshape(-1)
+        np.add.at(self.confusion.matrix, (actual, predicted), 1)
+
     def merge(self, other: "Evaluation") -> "Evaluation":
         """Fold another evaluation's counts into this one (reference
         ``IEvaluation.merge`` — the Spark distributed-eval aggregation)."""

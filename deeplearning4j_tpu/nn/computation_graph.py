@@ -26,7 +26,7 @@ from .. import monitor as _monitor
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      DuplicateToTimeSeriesVertex,
                                      LastTimeStepVertex, LayerVertex)
-from ..datasets.dataset import DataSet, MultiDataSet
+from ..datasets.dataset import DataSet, MultiDataSet, wire_of
 
 Array = jax.Array
 
@@ -35,12 +35,18 @@ def _as_multi(data) -> MultiDataSet:
     if isinstance(data, MultiDataSet):
         return data
     if isinstance(data, DataSet):
-        return MultiDataSet(
+        mds = MultiDataSet(
             features=[data.features], labels=[data.labels],
             features_masks=(None if data.features_mask is None
                             else [data.features_mask]),
             labels_masks=(None if data.labels_mask is None
                           else [data.labels_mask]))
+        wire = wire_of(data)
+        if wire is not None:
+            # per-input wire list (ingest.multi_window_wire): a wrapped
+            # DataSet wires its single input
+            mds._wires = [wire]
+        return mds
     raise TypeError(f"Expected DataSet/MultiDataSet, got {type(data)}")
 
 
@@ -287,11 +293,17 @@ class ComputationGraph:
         than by host→device dispatch latency (the reference's inner loop
         is host-driven, ``StochasticGradientDescent.java:50-72``)."""
 
+        from . import ingest
+
         def multi(params, updater_state, net_state, iteration, features,
-                  labels, features_masks, labels_masks, base_rng):
+                  labels, features_masks, labels_masks, base_rng,
+                  wires=None):
             def body(carry, xs):
                 p, u, s, it = carry
                 f, l, fm, lm = xs
+                if wires is not None:
+                    f = [ingest.device_decode(fi, w)
+                         for fi, w in zip(f, wires)]
                 rng = jax.random.fold_in(base_rng, it)
                 (data_loss, (new_s, _)), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True)(
@@ -312,17 +324,38 @@ class ComputationGraph:
 
     @functools.cached_property
     def _gather_train_step(self):
-        """Device-cached-epoch graph train step: ``lax.scan`` over
-        (S, B) index rows gathering each minibatch from HBM-resident
-        per-input dataset arrays (see
-        ``MultiLayerNetwork._gather_train_step`` — per-epoch
-        host->device traffic is one int32 index array)."""
+        """Device-cached-epoch graph train step, v2 (see
+        ``MultiLayerNetwork._gather_train_step``): the epoch permutation
+        is derived ON DEVICE from ``fold_in(shuffle_key, epoch)`` and up
+        to ``fused`` epochs scan in one XLA program, each step gathering
+        its minibatch from HBM-resident per-input dataset arrays —
+        steady-state epochs move zero bytes host->device.  ``wires`` is
+        the per-input ``(denom, mult, add)``/None tuple fusing the uint8
+        wire decode into the gathered batch."""
+        from . import ingest
 
         def multi(params, updater_state, net_state, iteration, data_fs,
-                  data_ls, idx, base_rng):
+                  data_ls, base_rng, shuffle_key, first_epoch, fused,
+                  steps, batch, shuffle, tail, wires):
+            n = data_fs[0].shape[0]
+
+            def epoch_rows(e):
+                if shuffle:
+                    perm = jax.random.permutation(
+                        jax.random.fold_in(shuffle_key, e), n)
+                else:
+                    perm = jnp.arange(n)
+                if tail:
+                    return perm[steps * batch:].reshape(1, tail)
+                return perm[:steps * batch].reshape(steps, batch)
+
+            rows = jax.vmap(epoch_rows)(first_epoch + jnp.arange(fused))
+            rows = rows.reshape((-1,) + rows.shape[2:])
+
             def body(carry, idx_row):
                 p, u, s, it = carry
-                f = [jnp.take(d, idx_row, axis=0) for d in data_fs]
+                f = [ingest.device_decode(jnp.take(d, idx_row, axis=0), w)
+                     for d, w in zip(data_fs, wires)]
                 l = [jnp.take(d, idx_row, axis=0) for d in data_ls]
                 rng = jax.random.fold_in(base_rng, it)
                 (data_loss, (new_s, _)), grads = jax.value_and_grad(
@@ -335,59 +368,38 @@ class ComputationGraph:
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
             (params, updater_state, net_state, _), scores = jax.lax.scan(
-                body, init, idx)
+                body, init, rows)
             return params, updater_state, net_state, scores
 
         return _monitor.watched_jit(multi, name="cg.gather_train_step",
+                                    static_argnums=(9, 10, 11, 12, 13),
                                     donate_argnums=(0, 1, 2))
 
     def _fit_device_cached(self, source, epochs: int):
         """Graph twin of ``MultiLayerNetwork._fit_device_cached``:
         ``source`` is a vetted ``ListDataSetIterator`` (single-input
-        DataSets); the dataset lives on device across epochs and each
-        epoch is one gather-scan dispatch per batch-shape."""
+        DataSets); the dataset lives on device across fits (uint8 wire
+        form when the source carries one) and consecutive epochs fuse
+        into single gather-scan dispatches via the shared
+        ``ingest.run_device_cached_fit`` driver."""
         from . import ingest
 
-        dev_f, dev_l = ingest.device_cached_arrays(self, source._ds)
+        dev_f, dev_l, wire = ingest.device_cached_arrays(
+            self, source._ds, source.get_preprocessor())
         data_fs, data_ls = (dev_f,), (dev_l,)
-        replay = ingest.ScoreReplayer(self)
-        iters = _monitor.counter("train_iterations_total",
-                                 "supervised train iterations")
-        for _ in range(epochs):
-            with _monitor.span("fit/epoch", epoch=self.epoch,
-                               path="cache"):
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_start"):
-                        listener.on_epoch_start(self)
-                t0 = time.perf_counter()
-                order = ingest.epoch_order(source)
-                batches = list(ingest.epoch_index_batches(
-                    order, source._batch))
-                _monitor.observe_phase("data", time.perf_counter() - t0)
-                for idx in batches:
-                    t1 = time.perf_counter()
-                    (self.params, self.updater_state, self.net_state,
-                     scores) = self._gather_train_step(
-                        self.params, self.updater_state, self.net_state,
-                        self.iteration, data_fs, data_ls, jnp.asarray(idx),
-                        self._rng_key)
-                    replay.add(self.iteration, scores)
-                    _monitor.observe_phase("step",
-                                           time.perf_counter() - t1)
-                    iters.inc(idx.shape[0])
-                    self.iteration += idx.shape[0]
-                    self.last_batch_size = idx.shape[1]
-                if self.listeners:
-                    t2 = time.perf_counter()
-                    replay.replay()
-                    _monitor.observe_phase("listener",
-                                           time.perf_counter() - t2)
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
-                self.epoch += 1
-        replay.finish()
-        return self
+        shuffle_key = jax.random.fold_in(self._rng_key, 0xFFFFFFFF)
+        steps = source._ds.num_examples() // source._batch
+
+        def dispatch(first_epoch, fused, tail):
+            (self.params, self.updater_state, self.net_state,
+             scores) = self._gather_train_step(
+                self.params, self.updater_state, self.net_state,
+                self.iteration, data_fs, data_ls, self._rng_key,
+                shuffle_key, first_epoch, fused, steps, source._batch,
+                bool(source._shuffle), tail, (wire,))
+            return scores
+
+        return ingest.run_device_cached_fit(self, source, epochs, dispatch)
 
     def _fit_windowed(self, iterator, epochs: int, window: int):
         """Graph twin of ``MultiLayerNetwork._fit_windowed``: stream
@@ -401,19 +413,29 @@ class ComputationGraph:
             t0 = time.perf_counter()
             features, labels, fms, lms = ingest.stack_multi_window(buf)
             cdt = self.conf.conf.compute_dtype
-            features = [ingest.cast_for_transfer(f, cdt) for f in features]
+            u8s, wires = ingest.multi_window_wire(buf, len(features))
+            features = [
+                u8s[i] if u8s is not None and u8s[i] is not None
+                else ingest.cast_for_transfer(f, cdt)
+                for i, f in enumerate(features)]
             features = [jnp.asarray(f) for f in features]
             labels = [jnp.asarray(l) for l in labels]
             fms = (None if fms is None else [
                 None if m is None else jnp.asarray(m) for m in fms])
             lms = (None if lms is None else [
                 None if m is None else jnp.asarray(m) for m in lms])
+            _monitor.gauge(
+                "ingest_staged_bytes",
+                "bytes uploaded to the device per staging event").set(
+                sum(f.nbytes for f in features)
+                + sum(l.nbytes for l in labels), path="window")
             t1 = time.perf_counter()
             _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,
-                self.iteration, features, labels, fms, lms, self._rng_key)
+                self.iteration, features, labels, fms, lms, self._rng_key,
+                wires)
             replay.add(self.iteration, scores)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             _monitor.counter("train_iterations_total",
@@ -577,6 +599,23 @@ class ComputationGraph:
                                        input_masks=input_masks)
             return [acts[o] for o in self.conf.network_outputs]
         return _monitor.watched_jit(run, name="cg.output")
+
+    @functools.cached_property
+    def _eval_argmax_fn(self):
+        """Single-output inference forward + argmax in one program:
+        evaluation transfers int32 class indices, not logits."""
+        def run(params, net_state, features, features_masks):
+            input_masks = None
+            if features_masks is not None:
+                input_masks = {
+                    n: m for n, m in zip(self.conf.network_inputs,
+                                         features_masks) if m is not None}
+            acts, _, _ = self._forward(params, net_state, features,
+                                       train=False, rng=None,
+                                       input_masks=input_masks)
+            out = acts[self.conf.network_outputs[0]]
+            return jnp.argmax(out, axis=-1).astype(jnp.int32)
+        return _monitor.watched_jit(run, name="cg.eval_argmax")
 
     @functools.cached_property
     def _score_fn(self):
@@ -1047,14 +1086,16 @@ class ComputationGraph:
         if len(self.conf.network_outputs) != 1:
             raise ValueError("do_evaluation() requires a single-output "
                              "graph")
+        from ..eval.evaluation import Evaluation
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         if hasattr(iterator, "reset"):
             iterator.reset()
+        fast = bool(evaluators) and all(
+            type(ev) is Evaluation and ev.top_n == 1 for ev in evaluators)
+        bytes_moved = 0
         for ds in iterator:
             mds = _as_multi(ds)
-            out = self.output(*mds.features,
-                              features_masks=mds.features_masks)
             labels = np.asarray(mds.labels[0])
             mask = None
             if mds.labels_masks is not None:
@@ -1062,11 +1103,36 @@ class ComputationGraph:
             elif mds.features_masks is not None:
                 mask = mds.features_masks[0]
             mask = None if mask is None else np.asarray(mask)
+            if fast:
+                self.init()
+                feats = tuple(jnp.asarray(f) for f in mds.features)
+                fmasks = (None if mds.features_masks is None else tuple(
+                    None if m is None else jnp.asarray(m)
+                    for m in mds.features_masks))
+                guess = np.asarray(self._eval_argmax_fn(
+                    self.params, self.net_state, feats, fmasks))
+                bytes_moved += guess.nbytes
+                actual = labels.argmax(-1)
+                if labels.ndim == 3:
+                    actual, guess = actual.reshape(-1), guess.reshape(-1)
+                    if mask is not None:
+                        keep = mask.reshape(-1) > 0
+                        actual, guess = actual[keep], guess[keep]
+                for ev in evaluators:
+                    ev.eval_class_indices(actual, guess, labels.shape[-1])
+                continue
+            out = self.output(*mds.features,
+                              features_masks=mds.features_masks)
+            bytes_moved += out.nbytes
             for ev in evaluators:
                 if out.ndim == 3:
                     ev.eval_time_series(labels, out, mask)
                 else:
                     ev.eval(labels, out)
+        _monitor.gauge(
+            "eval_bytes_transferred",
+            "device->host bytes moved by the most recent do_evaluation",
+        ).set(bytes_moved, path="indices" if fast else "logits")
         return evaluators
 
     def evaluate(self, iterator):

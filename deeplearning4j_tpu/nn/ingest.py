@@ -7,17 +7,28 @@ The reference hides ETL behind compute with a prefetch thread
 link, the analogous wins are:
 
 1. **Device-resident epoch cache** — a dataset that fits in HBM is
-   uploaded ONCE and stays resident across epochs; each epoch is one
-   ``lax.scan`` dispatch whose body gathers its minibatch from the
-   resident arrays by index.  Per-epoch host traffic drops to one
-   (S, B) int32 index array (the epoch permutation), so throughput
-   approaches the staged-on-device compute ceiling instead of being
-   host-transfer-bound.
+   uploaded ONCE and stays resident across epochs; each epoch's
+   permutation is computed ON DEVICE (threefry keyed off the fit RNG)
+   inside the same ``lax.scan`` dispatch that gathers and trains, so
+   steady-state epochs have ZERO per-epoch host->device traffic — not
+   even the index upload v1 paid.  Consecutive epochs additionally
+   fuse into one dispatch (bounded by
+   :func:`max_steps_per_dispatch`) when no listeners need per-epoch
+   callbacks and there is no tail batch.
 2. **Windowed staging** — datasets that do not fit HBM stream in
    multi-batch windows: the host stacks window k+1 and enqueues its
    transfer while window k's multi-step scan runs on-chip (JAX async
    dispatch provides the overlap; nothing blocks until scores are
    fetched).
+
+Both paths ship the **uint8 wire** when the source carries one
+(``datasets/dataset.attach_wire``): integer-pixel datasets upload 1
+byte/pixel — 4x fewer bytes than float32 (47 MB instead of 188 MB for
+MNIST-60k) — and the ``f32(u8)/denom*mult+add`` decode is fused into
+the first ops of the compiled train step (:func:`device_decode`).  The
+decode replicates the host's float32 op order exactly, so wire and
+non-wire paths are BIT-EXACT for both float32 and bfloat16 compute
+(parity-tested; ``DL4J_TPU_WIRE_UINT8=0`` is the escape hatch).
 
 Both paths preserve per-iteration listener semantics by REPLAY: the
 scan returns per-step scores, and listeners fire once per underlying
@@ -29,9 +40,13 @@ compromise as ``fit_scan``).
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+from .. import monitor as _monitor
+from ..datasets.dataset import wire_enabled, wire_of
 
 #: Datasets larger than this (features + labels bytes) never device-cache.
 #: Default 2 GB leaves headroom on a 16 GB-HBM chip for params, updater
@@ -42,6 +57,25 @@ DEVICE_CACHE_LIMIT_BYTES = int(os.environ.get(
 _CACHEABLE_DTYPES = ("float32", "bfloat16")
 
 
+def max_steps_per_dispatch() -> int:
+    """Upper bound on scan steps folded into ONE epoch-cache dispatch
+    (``DL4J_TPU_MAX_STEPS_PER_DISPATCH``, default 1024).  Bounds both
+    the scanned score stack's HBM footprint and how long listeners can
+    lag behind the chip when epochs fuse."""
+    return int(os.environ.get("DL4J_TPU_MAX_STEPS_PER_DISPATCH", 1024))
+
+
+def _scaler_wire(preprocessor, features: np.ndarray):
+    """(u8, fmt) when ``preprocessor`` is an affine pixel scaler over
+    uint8 features — the one preprocessor whose transform the device
+    decode can reproduce bit-exactly — else None."""
+    from ..datasets.normalizers import wire_format_of
+    if preprocessor is None or features.dtype != np.uint8:
+        return None
+    fmt = wire_format_of(preprocessor)
+    return None if fmt is None else (features, fmt)
+
+
 def cacheable_source(iterator):
     """Return the underlying ``ListDataSetIterator`` when ``iterator``
     can be served by the device-resident epoch cache, else ``None``.
@@ -50,8 +84,11 @@ def cacheable_source(iterator):
     ``datasets/iterators.py``: exact ``ListDataSetIterator`` iteration
     semantics only (a subclass overriding ``__next__``/``reset`` keeps
     its override by falling back), dense float features/labels, no
-    masks, no preprocessor, and total bytes under
-    :data:`DEVICE_CACHE_LIMIT_BYTES`.
+    masks, and total bytes under :data:`DEVICE_CACHE_LIMIT_BYTES`.
+    Preprocessors disqualify — with ONE exception: an affine pixel
+    scaler (``ImagePreProcessingScaler``) over uint8 features, whose
+    transform IS the uint8 wire decode and therefore fuses into the
+    compiled step (wire enabled only).
     """
     from ..datasets.iterators import (AsyncDataSetIterator,
                                       ListDataSetIterator)
@@ -65,8 +102,6 @@ def cacheable_source(iterator):
     if (type(u).__next__ is not ListDataSetIterator.__next__
             or type(u).reset is not ListDataSetIterator.reset):
         return None
-    if u.get_preprocessor() is not None:
-        return None
     ds = u._ds
     if ds.features is None or ds.labels is None:
         return None
@@ -74,52 +109,104 @@ def cacheable_source(iterator):
         return None
     f = np.asarray(ds.features)
     l = np.asarray(ds.labels)
-    if f.dtype.name not in _CACHEABLE_DTYPES or \
-            l.dtype.name not in _CACHEABLE_DTYPES:
+    if u.get_preprocessor() is not None:
+        if not (wire_enabled()
+                and _scaler_wire(u.get_preprocessor(), f) is not None):
+            return None
+    elif f.dtype.name not in _CACHEABLE_DTYPES:
+        return None
+    if l.dtype.name not in _CACHEABLE_DTYPES:
         return None
     if f.nbytes + l.nbytes > DEVICE_CACHE_LIMIT_BYTES:
         return None
     return u
 
 
-def device_cached_arrays(model, ds) -> Tuple:
-    """Device copies of ``ds.features``/``ds.labels`` that stay resident
-    ACROSS ``fit()`` calls (true epoch-cache residency: without this,
-    every fit() re-paid the full dataset host->device transfer — 188 MB
-    for f32 MNIST — which dominated end-to-end throughput over the
-    tunnel).  The cache lives on the model and is keyed by host-array
-    identity: it holds references to the exact feature/label ndarrays it
-    uploaded, so re-use requires ``ds`` to still expose those same
-    objects; assigning new arrays re-uploads.  In-place mutation of the
-    same arrays between fits is NOT detected — matching the reference's
-    posture that a dataset is immutable while training on it."""
+def device_cached_arrays(model, ds, preprocessor=None) -> Tuple:
+    """``(dev_features, dev_labels, wire_spec)`` device copies of ``ds``
+    that stay resident ACROSS ``fit()`` calls (true epoch-cache
+    residency: without this, every fit() re-paid the full dataset
+    host->device transfer, which dominated end-to-end throughput over
+    the tunnel).
+
+    When ``ds`` carries a uint8 wire twin (or ``preprocessor`` is an
+    affine pixel scaler over uint8 features) and the wire is enabled,
+    the UINT8 buffer is what gets uploaded — 4x fewer bytes than
+    float32 — and ``wire_spec`` is the ``(denom, mult, add)`` float
+    triple whose on-device decode (:func:`device_decode`) reproduces
+    the float32 features bit-exactly.  ``wire_spec`` is None when the
+    float32 arrays shipped as-is.
+
+    The cache lives on the model and is keyed by host-array identity
+    (plus the wire decision, so flipping ``DL4J_TPU_WIRE_UINT8``
+    between fits re-uploads): it holds references to the exact
+    feature/label ndarrays it uploaded, so re-use requires ``ds`` to
+    still expose those same objects; assigning new arrays re-uploads.
+    In-place mutation of the same arrays between fits is NOT detected —
+    matching the reference's posture that a dataset is immutable while
+    training on it."""
     import jax.numpy as jnp
     f = np.asarray(ds.features)
     l = np.asarray(ds.labels)
+    wire = None
+    if wire_enabled():
+        w = wire_of(ds)
+        if w is not None and w[0].shape == f.shape:
+            wire = w
+        else:
+            wire = _scaler_wire(preprocessor, f)
+    fmt = None if wire is None else wire[1]
     cache = getattr(model, "_ingest_device_cache", None)
-    if cache is not None and cache[0] is f and cache[1] is l:
-        return cache[2], cache[3]
-    dev_f, dev_l = jnp.asarray(f), jnp.asarray(l)
-    model._ingest_device_cache = (f, l, dev_f, dev_l)
-    return dev_f, dev_l
+    if (cache is not None and cache[0] is f and cache[1] is l
+            and cache[2] == fmt):
+        return cache[3], cache[4], cache[5]
+    if wire is not None:
+        dev_f = jnp.asarray(np.ascontiguousarray(wire[0]))
+        wire_spec = fmt.as_tuple()
+    else:
+        dev_f = jnp.asarray(f)
+        wire_spec = None
+    dev_l = jnp.asarray(l)
+    _monitor.gauge(
+        "ingest_staged_bytes",
+        "bytes uploaded to the device per staging event").set(
+        dev_f.nbytes + dev_l.nbytes, path="cache")
+    model._ingest_device_cache = (f, l, fmt, dev_f, dev_l, wire_spec)
+    return dev_f, dev_l, wire_spec
 
 
-def epoch_order(u) -> np.ndarray:
-    """Advance ``u`` through one epoch's worth of state transitions and
-    return the example order that epoch would have used.
+def device_decode(f, wire):
+    """Fused on-device wire decode: ``f32(u8) / denom * mult + add``.
+    Applied unconditionally (all three ops) so the program shape never
+    depends on the wire VALUES — ``/1.0``, ``*1.0`` and ``+0.0`` are
+    exact float32 identities for the non-negative pixel range.  The op
+    order replicates the host readers' numpy float32 arithmetic
+    (``u8.astype(f32) / 255.0``; ``ImagePreProcessingScaler.transform``)
+    operation for operation, and IEEE-754 round-to-nearest-even makes
+    each op bit-identical between numpy and XLA — the root of the
+    wire-vs-float32 parity guarantee.  ``wire`` is a ``(denom, mult,
+    add)`` python-float triple (weak-typed scalars: values never force
+    a retrace) or None for pass-through."""
+    if wire is None:
+        return f
+    import jax.numpy as jnp
+    denom, mult, add = wire
+    return f.astype(jnp.float32) / denom * mult + add
 
-    The canonical ``fit(iterator)`` path resets twice per epoch (the
-    explicit ``it.reset()`` plus ``__iter__``'s reset), so the cache
-    path performs the same two resets — the permutation stream is
-    IDENTICAL to the per-batch path (exact-parity tested).  The
-    iterator is then marked consumed so external observers see a
-    finished epoch.
-    """
+
+def consume_epoch(u) -> None:
+    """Advance ``u`` through one epoch's worth of state transitions
+    without materializing any batches.  The canonical ``fit(iterator)``
+    path resets twice per epoch (the explicit ``it.reset()`` plus
+    ``__iter__``'s reset), so the cache path performs the same two
+    transitions and then marks the iterator consumed — external
+    observers (and a later fall-back to the per-batch path) see the
+    same iterator state.  The example ORDER itself comes from the
+    on-device threefry permutation stream, not from the iterator's
+    host RNG."""
     u.reset()
     u.reset()
-    order = np.asarray(u._order)
     u._pos = u._ds.num_examples()
-    return order
 
 
 def epoch_index_batches(order: np.ndarray,
@@ -195,6 +282,55 @@ def stack_multi_window(mbs) -> Tuple:
     return features, labels, fmasks, lmasks
 
 
+def window_wire(batches) -> Tuple[Optional[np.ndarray], Optional[Tuple]]:
+    """When EVERY batch in a window carries the same-format uint8 wire
+    twin (and the wire is enabled), return the stacked ``(W, B, ...)``
+    uint8 array plus the ``(denom, mult, add)`` spec — the windowed
+    path then ships 1 byte/pixel and decodes on device.  Else
+    ``(None, None)`` and the window stages float32 (or host-cast
+    bfloat16) as before."""
+    if not wire_enabled():
+        return None, None
+    wires = [wire_of(b) for b in batches]
+    if any(w is None for w in wires):
+        return None, None
+    if len({w[1] for w in wires}) != 1:
+        return None, None
+    if any(w[0].shape != np.shape(b.features)
+           for w, b in zip(wires, batches)):
+        return None, None
+    return np.stack([w[0] for w in wires]), wires[0][1].as_tuple()
+
+
+def multi_window_wire(mbs, n_in: int):
+    """Graph twin of :func:`window_wire`: per-input wire staging for a
+    window of MultiDataSets (wire twins ride on ``_wires``, attached by
+    ``computation_graph._as_multi`` when the source batch carried one).
+    Returns ``(stacks, specs)`` — per-input lists where a wired slot
+    holds its stacked (W, B, ...) uint8 array / ``(denom, mult, add)``
+    spec and an unwired slot holds None — or ``(None, None)`` when no
+    input wires."""
+    if not wire_enabled():
+        return None, None
+    wire_lists = [getattr(m, "_wires", None) for m in mbs]
+    stacks: List[Optional[np.ndarray]] = []
+    specs: List[Optional[Tuple]] = []
+    for i in range(n_in):
+        ok = all(w is not None and len(w) > i and w[i] is not None
+                 for w in wire_lists)
+        if (ok and len({w[i][1] for w in wire_lists}) == 1
+                and all(w[i][0].shape == np.shape(m.features[i])
+                        for w, m in zip(wire_lists, mbs))):
+            stacks.append(np.stack([w[i][0] for w in wire_lists]))
+            specs.append(wire_lists[0][i][1].as_tuple())
+        else:
+            stacks.append(None)
+            specs.append(None)
+    if all(s is None for s in stacks):
+        return None, None
+    return stacks, tuple(specs)
+
+
 def cast_for_transfer(features: np.ndarray, compute_dtype) -> np.ndarray:
     """Halve the windowed path's host->device bytes: when the model
     computes in bfloat16, cast float32 feature stacks on HOST before the
@@ -242,3 +378,68 @@ class ScoreReplayer:
         if self._pending:
             self._model._score = self._pending[-1][1][-1]
             self._pending = []
+
+
+def run_device_cached_fit(model, u, epochs: int, dispatch):
+    """Shared MLN/ComputationGraph driver for the device-resident
+    epoch-cache fit.  ``u`` is the vetted ``ListDataSetIterator``;
+    ``dispatch(first_epoch, fused_epochs, tail)`` invokes the model's
+    gather-scan train step (which derives each epoch's permutation on
+    device — see ``_gather_train_step``) and returns per-step scores.
+
+    One call per epoch normally; when no listeners are attached and the
+    batch divides the dataset (no tail), up to
+    :func:`max_steps_per_dispatch` steps' worth of CONSECUTIVE epochs
+    fold into a single dispatch — multi-epoch fits become a handful of
+    XLA invocations with zero host traffic between them.  Listeners
+    force per-epoch dispatches so score replay and epoch callbacks keep
+    their per-iteration/per-epoch semantics.  A tail batch runs as its
+    own 1-step dispatch (same on-device permutation, last ``tail``
+    entries), preserving the per-batch path's batch boundaries."""
+    replay = ScoreReplayer(model)
+    iters = _monitor.counter("train_iterations_total",
+                             "supervised train iterations")
+    n = u._ds.num_examples()
+    batch = u._batch
+    steps, tail = divmod(n, batch)
+    fuse_cap = max(1, max_steps_per_dispatch() // max(1, steps))
+    done = 0
+    while done < epochs:
+        fuse = 1
+        if not model.listeners and tail == 0 and steps > 0:
+            fuse = min(epochs - done, fuse_cap)
+        with _monitor.span("fit/epoch", epoch=model.epoch, path="cache",
+                           fused=fuse):
+            for listener in model.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(model)
+            t0 = time.perf_counter()
+            for _ in range(fuse):
+                consume_epoch(u)
+            _monitor.observe_phase("data", time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            if steps:
+                scores = dispatch(model.epoch, fuse, 0)
+                replay.add(model.iteration, scores)
+                iters.inc(fuse * steps)
+                model.iteration += fuse * steps
+                model.last_batch_size = batch
+            if tail:
+                scores = dispatch(model.epoch, 1, tail)
+                replay.add(model.iteration, scores)
+                iters.inc(1)
+                model.iteration += 1
+                model.last_batch_size = tail
+            _monitor.observe_phase("step", time.perf_counter() - t1)
+            if model.listeners:
+                t2 = time.perf_counter()
+                replay.replay()     # blocks: exact per-step scores
+                _monitor.observe_phase("listener",
+                                       time.perf_counter() - t2)
+            for listener in model.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(model)
+            model.epoch += fuse
+        done += fuse
+    replay.finish()
+    return model

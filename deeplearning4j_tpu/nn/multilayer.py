@@ -258,11 +258,14 @@ class MultiLayerNetwork:
         iteration); on TPU the scan keeps the whole loop on-chip, so
         throughput is set by the MXU, not by host dispatch latency."""
 
+        from . import ingest
+
         def multi(params, updater_state, net_state, iteration, features,
-                  labels, features_mask, labels_mask, base_rng):
+                  labels, features_mask, labels_mask, base_rng, wire=None):
             def body(carry, xs):
                 p, u, s, it = carry
                 f, l, fm, lm = xs
+                f = ingest.device_decode(f, wire)
                 rng = jax.random.fold_in(base_rng, it)
                 (data_loss, (new_s, _)), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True)(
@@ -282,20 +285,47 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _gather_train_step(self):
-        """Device-cached-epoch train step: ``lax.scan`` over (S, B)
-        index rows, the body GATHERING its minibatch from the
-        HBM-resident dataset arrays.  Per-epoch host->device traffic is
-        one int32 index array (~KBs) instead of the whole epoch
-        (~100s of MB) — the TPU answer to the reference's
-        ``AsyncDataSetIterator`` prefetch (``fit:976-980``): where the
-        reference hides ETL behind compute, a resident dataset makes
-        per-epoch ETL disappear."""
+        """Device-cached-epoch train step, v2: the epoch PERMUTATION is
+        computed on device (threefry ``fold_in(shuffle_key, epoch)``
+        feeding ``jax.random.permutation``) and up to ``fused`` whole
+        epochs scan in ONE XLA program, each step gathering its
+        minibatch from the HBM-resident dataset arrays.  v1 uploaded a
+        host-shuffled (S, B) int32 index array every epoch; v2's
+        steady-state epochs move ZERO bytes host->device — the epoch
+        loop never leaves the chip.  When the resident features are the
+        uint8 wire, the affine decode fuses into the gathered batch
+        (``ingest.device_decode``).
+
+        Static args (``fused``/``steps``/``batch``/``shuffle``/
+        ``tail``) fix the program shape; ``first_epoch`` stays dynamic
+        (weak int32) so advancing epochs never retraces.  ``tail > 0``
+        selects the 1-step tail dispatch: the SAME epoch permutation is
+        recomputed and its last ``tail`` entries form the ragged final
+        batch, keeping v1's batch boundaries."""
+        from . import ingest
 
         def multi(params, updater_state, net_state, iteration, data_f,
-                  data_l, idx, base_rng):
+                  data_l, base_rng, shuffle_key, first_epoch, fused,
+                  steps, batch, shuffle, tail, wire):
+            n = data_f.shape[0]
+
+            def epoch_rows(e):
+                if shuffle:
+                    perm = jax.random.permutation(
+                        jax.random.fold_in(shuffle_key, e), n)
+                else:
+                    perm = jnp.arange(n)
+                if tail:
+                    return perm[steps * batch:].reshape(1, tail)
+                return perm[:steps * batch].reshape(steps, batch)
+
+            rows = jax.vmap(epoch_rows)(first_epoch + jnp.arange(fused))
+            rows = rows.reshape((-1,) + rows.shape[2:])
+
             def body(carry, idx_row):
                 p, u, s, it = carry
-                f = jnp.take(data_f, idx_row, axis=0)
+                f = ingest.device_decode(
+                    jnp.take(data_f, idx_row, axis=0), wire)
                 l = jnp.take(data_l, idx_row, axis=0)
                 rng = jax.random.fold_in(base_rng, it)
                 (data_loss, (new_s, _)), grads = jax.value_and_grad(
@@ -308,61 +338,41 @@ class MultiLayerNetwork:
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
             (params, updater_state, net_state, _), scores = jax.lax.scan(
-                body, init, idx)
+                body, init, rows)
             return params, updater_state, net_state, scores
 
         return _monitor.watched_jit(multi, name="mln.gather_train_step",
+                                    static_argnums=(9, 10, 11, 12, 13),
                                     donate_argnums=(0, 1, 2))
 
     def _fit_device_cached(self, source, epochs: int):
         """One ``fit`` over a device-resident dataset (see
         ``_gather_train_step``).  ``source`` is the underlying
         ``ListDataSetIterator`` vetted by ``ingest.cacheable_source``.
-        Epoch order, batch boundaries (incl. the tail batch) and the
-        per-iteration RNG/updater stream are IDENTICAL to the per-batch
-        path — exact-parity tested; listeners fire per iteration by
-        replaying the scanned scores."""
+        Batch boundaries (incl. the tail batch) and the per-iteration
+        RNG/updater stream are IDENTICAL to the per-batch path; the
+        example order comes from the on-device threefry permutation
+        stream (keyed off the fit RNG, continuing across fits via
+        ``self.epoch``) — parity-tested against a host replay of the
+        same permutations.  Listeners fire per iteration by replaying
+        the scanned scores."""
         from . import ingest
 
-        data_f, data_l = ingest.device_cached_arrays(self, source._ds)
-        replay = ingest.ScoreReplayer(self)
-        iters = _monitor.counter("train_iterations_total",
-                                 "supervised train iterations")
-        for _ in range(epochs):
-            with _monitor.span("fit/epoch", epoch=self.epoch,
-                               path="cache"):
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_start"):
-                        listener.on_epoch_start(self)
-                t0 = time.perf_counter()
-                order = ingest.epoch_order(source)
-                batches = list(ingest.epoch_index_batches(
-                    order, source._batch))
-                _monitor.observe_phase("data", time.perf_counter() - t0)
-                for idx in batches:
-                    t1 = time.perf_counter()
-                    (self.params, self.updater_state, self.net_state,
-                     scores) = self._gather_train_step(
-                        self.params, self.updater_state, self.net_state,
-                        self.iteration, data_f, data_l, jnp.asarray(idx),
-                        self._rng_key)
-                    replay.add(self.iteration, scores)
-                    _monitor.observe_phase("step",
-                                           time.perf_counter() - t1)
-                    iters.inc(idx.shape[0])
-                    self.iteration += idx.shape[0]
-                    self.last_batch_size = idx.shape[1]
-                if self.listeners:
-                    t2 = time.perf_counter()
-                    replay.replay()     # blocks: exact per-step scores
-                    _monitor.observe_phase("listener",
-                                           time.perf_counter() - t2)
-                for listener in self.listeners:
-                    if hasattr(listener, "on_epoch_end"):
-                        listener.on_epoch_end(self)
-                self.epoch += 1
-        replay.finish()
-        return self
+        data_f, data_l, wire = ingest.device_cached_arrays(
+            self, source._ds, source.get_preprocessor())
+        shuffle_key = jax.random.fold_in(self._rng_key, 0xFFFFFFFF)
+        steps = source._ds.num_examples() // source._batch
+
+        def dispatch(first_epoch, fused, tail):
+            (self.params, self.updater_state, self.net_state,
+             scores) = self._gather_train_step(
+                self.params, self.updater_state, self.net_state,
+                self.iteration, data_f, data_l, self._rng_key,
+                shuffle_key, first_epoch, fused, steps, source._batch,
+                bool(source._shuffle), tail, wire)
+            return scores
+
+        return ingest.run_device_cached_fit(self, source, epochs, dispatch)
 
     def _fit_windowed(self, iterator, epochs: int, window: int):
         """Streaming ``fit(iterator)`` in multi-batch windows: the host
@@ -378,18 +388,27 @@ class MultiLayerNetwork:
         def dispatch(buf):
             t0 = time.perf_counter()
             features, labels, fm, lm = ingest.stack_window(buf)
-            features = ingest.cast_for_transfer(
-                features, self.conf.conf.compute_dtype)
+            u8, wire = ingest.window_wire(buf)
+            if u8 is not None:
+                features = u8      # 1 byte/pixel; decode fused on device
+            else:
+                features = ingest.cast_for_transfer(
+                    features, self.conf.conf.compute_dtype)
             features = jnp.asarray(features)
             labels = jnp.asarray(labels)
             fm = None if fm is None else jnp.asarray(fm)
             lm = None if lm is None else jnp.asarray(lm)
+            _monitor.gauge(
+                "ingest_staged_bytes",
+                "bytes uploaded to the device per staging event").set(
+                features.nbytes + labels.nbytes, path="window")
             t1 = time.perf_counter()
             _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
              scores) = self._multi_train_step(
                 self.params, self.updater_state, self.net_state,
-                self.iteration, features, labels, fm, lm, self._rng_key)
+                self.iteration, features, labels, fm, lm, self._rng_key,
+                wire)
             replay.add(self.iteration, scores)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             _monitor.counter("train_iterations_total",
@@ -587,6 +606,17 @@ class MultiLayerNetwork:
                                       mask=features_mask)
             return out
         return _monitor.watched_jit(run, name="mln.output")
+
+    @functools.cached_property
+    def _eval_argmax_fn(self):
+        """Inference forward + argmax in one program: evaluation transfers
+        int32 class indices, not (batch, classes) logits."""
+        def run(params, net_state, features, features_mask):
+            out, _, _ = self._forward(params, net_state, features,
+                                      train=False, rng=None,
+                                      mask=features_mask)
+            return jnp.argmax(out, axis=-1).astype(jnp.int32)
+        return _monitor.watched_jit(run, name="mln.eval_argmax")
 
     @functools.cached_property
     def _rnn_step_fn(self):
@@ -1046,22 +1076,55 @@ class MultiLayerNetwork:
         """Run one forward pass per batch, feeding every evaluator
         (reference ``doEvaluation(iterator, IEvaluation...)``) —
         time-series outputs go through the masked ``evalTimeSeries``
-        path.  Returns the evaluators."""
+        path.  Returns the evaluators.
+
+        When every evaluator is a plain top-1 ``Evaluation``, the argmax
+        runs on device fused into the forward program and only int32
+        class indices cross the wire; label argmax and mask filtering
+        stay on the host where the labels already live.  The
+        ``eval_bytes_transferred`` gauge reports what the last
+        evaluation actually moved device->host."""
+        from ..eval.evaluation import Evaluation
         if isinstance(iterator, DataSet):
             iterator = [iterator]
         if hasattr(iterator, "reset"):
             iterator.reset()
+        fast = bool(evaluators) and all(
+            type(ev) is Evaluation and ev.top_n == 1 for ev in evaluators)
+        bytes_moved = 0
         for ds in iterator:
-            out = self.output(ds.features, features_mask=ds.features_mask)
             labels = np.asarray(ds.labels)
             mask = (ds.labels_mask if ds.labels_mask is not None
                     else ds.features_mask)
             mask = None if mask is None else np.asarray(mask)
+            if fast:
+                self.init()
+                fmask = (None if ds.features_mask is None
+                         else jnp.asarray(ds.features_mask))
+                guess = np.asarray(self._eval_argmax_fn(
+                    self.params, self.net_state, jnp.asarray(ds.features),
+                    fmask))
+                bytes_moved += guess.nbytes
+                actual = labels.argmax(-1)
+                if labels.ndim == 3:
+                    actual, guess = actual.reshape(-1), guess.reshape(-1)
+                    if mask is not None:
+                        keep = mask.reshape(-1) > 0
+                        actual, guess = actual[keep], guess[keep]
+                for ev in evaluators:
+                    ev.eval_class_indices(actual, guess, labels.shape[-1])
+                continue
+            out = self.output(ds.features, features_mask=ds.features_mask)
+            bytes_moved += out.nbytes
             for ev in evaluators:
                 if out.ndim == 3:
                     ev.eval_time_series(labels, out, mask)
                 else:
                     ev.eval(labels, out)
+        _monitor.gauge(
+            "eval_bytes_transferred",
+            "device->host bytes moved by the most recent do_evaluation",
+        ).set(bytes_moved, path="indices" if fast else "logits")
         return evaluators
 
     def evaluate(self, iterator):

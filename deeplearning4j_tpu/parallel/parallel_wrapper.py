@@ -115,7 +115,7 @@ class ParallelWrapper:
         avg_updaters = self.average_updaters
 
         def local_round(params, updater_state, net_state, iteration,
-                        features, labels, fmask, lmask, base_rng):
+                        features, labels, fmask, lmask, base_rng, wire):
             # Global shapes: batches (avg_freq, workers, batch, ...) and
             # updater state (workers, ...); this worker's view carries a
             # leading worker axis of size 1 — drop it.  features/labels are
@@ -138,8 +138,17 @@ class ParallelWrapper:
                                           to="varying")
 
             def one_step(carry, batch):
+                from ..nn import ingest
                 params, updater_state, net_state, it = carry
                 f, l, fm, lm = batch
+                if wire is not None:
+                    # uint8 wire staging: batches crossed the host->device
+                    # link at 1 byte/pixel; the affine decode fuses here
+                    if isinstance(f, tuple):      # graph: per-input specs
+                        f = tuple(ingest.device_decode(fi, w)
+                                  for fi, w in zip(f, wire))
+                    else:
+                        f = ingest.device_decode(f, wire)
                 rng = jax.random.fold_in(
                     jax.random.fold_in(base_rng, it), widx)
                 (data_loss, aux), grads = jax.value_and_grad(
@@ -169,7 +178,8 @@ class ParallelWrapper:
 
         mesh = self.mesh
         in_specs = (P(), P("data"), P(), P(), P(None, "data"),
-                    P(None, "data"), P(None, "data"), P(None, "data"), P())
+                    P(None, "data"), P(None, "data"), P(None, "data"), P(),
+                    P())
         out_specs = (P(), P("data"), P(), P())
         fn = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
@@ -304,13 +314,31 @@ class ParallelWrapper:
                     "averaging round; provide masks on all batches or none")
             return stack(get)
 
+        from ..datasets.dataset import wire_enabled, wire_of
+        wire = None
         if self._is_graph:
             from ..nn.computation_graph import _as_multi
             batches = [_as_multi(ds) for ds in batches]
             n_in = len(batches[0].features)
             n_out = len(batches[0].labels)
-            feats = tuple(stack(lambda m, s=s: m.features[s])
-                          for s in range(n_in))
+            mwires = [getattr(m, "_wires", None) for m in batches]
+            feats_list, specs = [], []
+            for s in range(n_in):
+                wired = (wire_enabled()
+                         and all(mw is not None and len(mw) > s
+                                 and mw[s] is not None for mw in mwires)
+                         and len({mw[s][1] for mw in mwires}) == 1
+                         and all(mw[s][0].shape == np.shape(m.features[s])
+                                 for mw, m in zip(mwires, batches)))
+                if wired:
+                    feats_list.append(stack(lambda m, s=s: m._wires[s][0]))
+                    specs.append(mwires[0][s][1].as_tuple())
+                else:
+                    feats_list.append(stack(lambda m, s=s: m.features[s]))
+                    specs.append(None)
+            feats = tuple(feats_list)
+            if any(x is not None for x in specs):
+                wire = tuple(specs)
             labs = tuple(stack(lambda m, s=s: m.labels[s])
                          for s in range(n_out))
             fmask = tuple(stack_masks(
@@ -324,7 +352,15 @@ class ParallelWrapper:
             if all(m is None for m in lmask):
                 lmask = None
         else:
-            feats = stack(lambda ds: ds.features)
+            ws = [wire_of(ds) for ds in batches]
+            if (wire_enabled() and all(x is not None for x in ws)
+                    and len({x[1] for x in ws}) == 1
+                    and all(x[0].shape == np.shape(ds.features)
+                            for x, ds in zip(ws, batches))):
+                feats = stack(lambda ds: wire_of(ds)[0])
+                wire = ws[0][1].as_tuple()
+            else:
+                feats = stack(lambda ds: ds.features)
             labs = stack(lambda ds: ds.labels)
             fmask = stack_masks(lambda ds: ds.features_mask)
             lmask = stack_masks(lambda ds: ds.labels_mask)
@@ -336,8 +372,13 @@ class ParallelWrapper:
             fmask = jax.device_put(jax.tree.map(jnp.asarray, fmask), sharding)
         if lmask is not None:
             lmask = jax.device_put(jax.tree.map(jnp.asarray, lmask), sharding)
+        _monitor.gauge(
+            "ingest_staged_bytes",
+            "bytes uploaded to the device per staging event").set(
+            sum(a.nbytes for a in jax.tree_util.tree_leaves((feats, labs))),
+            path="parallel")
         _monitor.observe_phase("data", time.perf_counter() - t0)
-        return feats, labs, fmask, lmask
+        return feats, labs, fmask, lmask, wire
 
     def _dispatch_round(self, staged) -> None:
         """Device side of a round: run the fused local-steps + pmean
@@ -345,7 +386,7 @@ class ParallelWrapper:
         back into the model."""
         net = self.model
         k, w = self.averaging_frequency, self.workers
-        feats, labs, fmask, lmask = staged
+        feats, labs, fmask, lmask, wire = staged
         if self._worker_ustate is None:
             # Replicate the model's updater state to every worker (the
             # reference's per-worker model replication at Trainer start).
@@ -359,7 +400,7 @@ class ParallelWrapper:
         (net.params, self._worker_ustate, net.net_state,
          score) = self._parallel_step(
             net.params, self._worker_ustate, net.net_state,
-            net.iteration, feats, labs, fmask, lmask, net._rng_key)
+            net.iteration, feats, labs, fmask, lmask, net._rng_key, wire)
         _monitor.observe_phase("step", time.perf_counter() - t1)
         _monitor.counter("parallel_rounds_total",
                          "parameter-averaging rounds (one pmean sync "

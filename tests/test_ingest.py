@@ -1,33 +1,41 @@
-"""Ingest-path parity tests: the device-resident epoch cache and the
-windowed double-buffered staging path must train IDENTICALLY to the
-canonical per-batch ``fit(iterator)`` loop (same permutation stream,
-same batch boundaries incl. tail, same RNG/updater sequence), and
-listeners must see the same per-iteration scores via replay.
+"""Ingest-path parity tests (v2, docs/INGEST.md): the device-resident
+epoch cache and the windowed staging path must train IDENTICALLY to the
+canonical per-batch ``fit(iterator)`` loop whenever the example order
+coincides (shuffle off, or the same batch list), the uint8 wire must be
+BIT-EXACT against the float32 wire on every path, the on-device
+shuffle must be deterministic per seed, and listener-free epochs must
+fuse into a single scan dispatch.
+
+v2 change of contract: with shuffle ON, the cache path's example order
+comes from the on-device threefry stream, NOT the iterator's host
+``RandomState`` — so shuffled cache runs are compared for determinism
+(same seed ⇒ same params), not for equality with the per-batch order.
 
 Reference contract being matched: ``AsyncDataSetIterator`` prefetch
 feeding ``MultiLayerNetwork.fit:976-980`` changes WHERE batches are
-assembled, never WHAT the optimizer sees — these paths keep that
-invariant on TPU.
+assembled, never WHAT the optimizer sees.
 """
 
 import numpy as np
-import jax.numpy as jnp
 import pytest
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.datasets.dataset import (DataSet, attach_wire,
+                                                 wire_of)
 from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
                                                    ExistingDataSetIterator,
                                                    ListDataSetIterator)
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler, U8_PIXEL)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf import inputs
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
     NeuralNetConfiguration)
-from deeplearning4j_tpu.nn.ingest import (cacheable_source,
+from deeplearning4j_tpu.nn.ingest import (cacheable_source, consume_epoch,
                                           epoch_index_batches)
 from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
-from deeplearning4j_tpu.nn.conf.computation_graph import (
-    ComputationGraphConfiguration)
 
 
 def _data(n=70, n_in=6, n_classes=3, seed=0):
@@ -37,10 +45,22 @@ def _data(n=70, n_in=6, n_classes=3, seed=0):
     return DataSet(X, y)
 
 
-def _mln(seed=7, n_in=6, n_classes=3, updater="adam"):
-    conf = (NeuralNetConfiguration.builder()
-            .seed(seed).updater(updater).learning_rate(0.05)
-            .activation("tanh").weight_init("xavier").list()
+def _wired_data(n=70, n_in=8, n_classes=3, seed=0):
+    """Synthetic integer-pixel dataset exactly as the readers build it:
+    f32 features ARE the numpy decode of the u8 twin."""
+    rng = np.random.RandomState(seed)
+    u8 = rng.randint(0, 256, (n, n_in), dtype=np.uint8)
+    y = np.eye(n_classes, dtype=np.float32)[rng.randint(0, n_classes, n)]
+    return attach_wire(DataSet(U8_PIXEL.decode_host(u8), y), u8, U8_PIXEL)
+
+
+def _mln(seed=7, n_in=6, n_classes=3, updater="adam", compute_dtype=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater).learning_rate(0.05)
+         .activation("tanh").weight_init("xavier"))
+    if compute_dtype:
+        b = b.compute_dtype(compute_dtype)
+    conf = (b.list()
             .layer(DenseLayer(n_out=10))
             .layer(OutputLayer(n_out=n_classes))
             .set_input_type(inputs.feed_forward(n_in))
@@ -65,6 +85,13 @@ def _flat(params):
     import jax
     return np.concatenate([np.asarray(x).ravel()
                            for x in jax.tree.leaves(params)])
+
+
+def _gather_calls(fn):
+    """Total dispatches of the fused gather step = compiles + cache
+    hits (the compile-watch counts both)."""
+    return (monitor.counter("jit_compiles_total", "").value(fn=fn)
+            + monitor.counter("jit_cache_hits_total", "").value(fn=fn))
 
 
 # ------------------------------------------------------------ eligibility
@@ -93,6 +120,27 @@ def test_cacheable_source_eligibility():
     assert cacheable_source(ListDataSetIterator(f64, 16)) is None
 
 
+def test_cacheable_source_scaler_over_uint8(monkeypatch):
+    """The ONE admissible preprocessor: an affine pixel scaler over
+    uint8 features — its transform IS the wire decode — but only while
+    the wire is enabled."""
+    rng = np.random.RandomState(1)
+    u8 = DataSet(rng.randint(0, 256, (40, 8), dtype=np.uint8),
+                 np.eye(2, dtype=np.float32)[rng.randint(0, 2, 40)])
+    it = ListDataSetIterator(u8, 8)
+    it.set_preprocessor(ImagePreProcessingScaler())
+    monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", "1")
+    assert cacheable_source(it) is it
+    monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", "0")
+    assert cacheable_source(it) is None
+    # same scaler over FLOAT features: no u8 buffer to decode from
+    f32 = DataSet(np.asarray(u8.features, np.float32), u8.labels)
+    it3 = ListDataSetIterator(f32, 8)
+    it3.set_preprocessor(ImagePreProcessingScaler())
+    monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", "1")
+    assert cacheable_source(it3) is None
+
+
 def test_epoch_index_batches_boundaries():
     order = np.arange(70)
     idx = epoch_index_batches(order, 16)
@@ -102,22 +150,67 @@ def test_epoch_index_batches_boundaries():
     assert epoch_index_batches(np.arange(5), 16)[0].shape == (1, 5)
 
 
+def test_consume_epoch_marks_iterator_consumed():
+    it = ListDataSetIterator(_data(), 16, shuffle=True, seed=3)
+    consume_epoch(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
 # ------------------------------------------------------ exact-parity: MLN
 
 @pytest.mark.parametrize("updater", ["sgd", "adam"])
 def test_device_cached_fit_matches_per_batch_exactly(updater):
-    """Cache path == canonical per-batch path: same params after 2
-    epochs over a shuffled iterator WITH a tail batch (70 % 16 != 0)."""
+    """Cache path == canonical per-batch path when the example order
+    coincides (shuffle OFF): same params after 2 epochs over an
+    iterator WITH a tail batch (70 % 16 != 0)."""
     ds = _data()
     a, b = _mln(updater=updater), _mln(updater=updater)
-    a.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=2,
+    a.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
           ingest="batch")
-    b.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=2,
+    b.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
           ingest="cache")
     np.testing.assert_allclose(_flat(a.params), _flat(b.params),
                                rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(float(a.score(ds)), float(b.score(ds)),
                                rtol=1e-5)
+
+
+def test_device_shuffle_deterministic_and_effective():
+    """With shuffle ON, the cache path's order comes from the device
+    threefry stream: same seed ⇒ bit-identical runs; and the order
+    genuinely differs from the unshuffled pass."""
+    ds = _data()
+
+    def run(shuffle, seed=7):
+        net = _mln(seed=seed)
+        net.fit(ListDataSetIterator(ds, 16, shuffle=shuffle, seed=3),
+                epochs=2, ingest="cache")
+        return _flat(net.params)
+
+    np.testing.assert_array_equal(run(True), run(True))
+    assert not np.array_equal(run(True), run(False))
+
+
+def test_multi_epoch_fusion_single_dispatch():
+    """Listener-free epochs with no tail batch fold into ONE gather-scan
+    dispatch; attaching a listener forces one dispatch per epoch."""
+    ds = _data(n=64)          # 64 % 16 == 0: no tail, fusion-eligible
+    net = _mln()
+    before = _gather_calls("mln.gather_train_step")
+    net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=3,
+            ingest="cache")
+    assert _gather_calls("mln.gather_train_step") - before == 1
+
+    class L:
+        def iteration_done(self, model, iteration):
+            pass
+    net2 = _mln()
+    net2.set_listeners(L())
+    before = _gather_calls("mln.gather_train_step")
+    net2.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=3,
+             ingest="cache")
+    assert _gather_calls("mln.gather_train_step") - before == 3
 
 
 def test_windowed_fit_matches_per_batch():
@@ -170,7 +263,8 @@ def test_windowed_fit_handles_masks_and_shape_changes():
 
 def test_ingest_listener_replay_scores_match():
     """Listeners on the overlapped paths see the SAME per-iteration
-    scores as the canonical path (replayed, not dropped)."""
+    scores as the canonical path (replayed, not dropped).  Shuffle off
+    so the cache path's example order coincides with per-batch."""
 
     class Collect:
         def __init__(self):
@@ -189,9 +283,9 @@ def test_ingest_listener_replay_scores_match():
         net = _mln()
         lst = Collect()
         net.set_listeners(lst)
-        it = (ListDataSetIterator(ds, 16, shuffle=True, seed=3)
+        it = (ListDataSetIterator(ds, 16, shuffle=False)
               if mode != "window" else ExistingDataSetIterator(
-                  list(ListDataSetIterator(ds, 16, shuffle=True, seed=3))))
+                  list(ListDataSetIterator(ds, 16, shuffle=False))))
         net.fit(it, epochs=2, ingest=mode)
         runs[mode] = lst
     iters_b = [i for i, _ in runs["batch"].scores]
@@ -203,7 +297,88 @@ def test_ingest_listener_replay_scores_match():
     # window mode ran over a REPLAYED list of the same batches: the
     # score stream matches the canonical path batch for batch
     sc_w = np.array([s for _, s in runs["window"].scores])
-    assert sc_w.shape == sc_b.shape
+    np.testing.assert_allclose(sc_b, sc_w, rtol=2e-5, atol=1e-7)
+
+
+# ------------------------------------------------- uint8 wire: bit-exact
+
+@pytest.mark.parametrize("compute_dtype", [None, "bfloat16"])
+def test_wire_parity_cache_bit_exact_mln(monkeypatch, compute_dtype):
+    """uint8 wire vs float32 wire on the epoch-cache path: BIT-EXACT
+    params (not allclose) after a shuffled multi-epoch fit with a tail
+    batch, for f32 and bf16 compute."""
+    ds = _wired_data()
+    assert wire_of(ds) is not None
+
+    def run(wire_flag):
+        monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", wire_flag)
+        net = _mln(n_in=8, compute_dtype=compute_dtype)
+        net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3),
+                epochs=2, ingest="cache")
+        return _flat(net.params)
+
+    np.testing.assert_array_equal(run("1"), run("0"))
+
+
+def test_wire_parity_cache_bit_exact_graph(monkeypatch):
+    ds = _wired_data()
+
+    def run(wire_flag):
+        monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", wire_flag)
+        net = _graph(n_in=8)
+        net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3),
+                epochs=2, ingest="cache")
+        return _flat(net.params)
+
+    np.testing.assert_array_equal(run("1"), run("0"))
+
+
+def test_wire_parity_window_bit_exact(monkeypatch):
+    """The windowed path ships sliced wire batches too — same bit-exact
+    guarantee (ListDataSetIterator slices the wire along with the
+    features)."""
+    ds = _wired_data(n=96)
+
+    def run(wire_flag):
+        monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", wire_flag)
+        net = _mln(n_in=8)
+        net.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
+                ingest="window", window=2)
+        return _flat(net.params)
+
+    np.testing.assert_array_equal(run("1"), run("0"))
+
+
+def test_wire_staged_bytes_are_uint8(monkeypatch):
+    """The residency gauge proves the u8 buffer (not f32) went over the
+    wire: staged bytes = n*(n_in*1 + n_classes*4)."""
+    monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", "1")
+    ds = _wired_data(n=64)
+    net = _mln(n_in=8)
+    net.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=1,
+            ingest="cache")
+    staged = monitor.gauge("ingest_staged_bytes", "").value(path="cache")
+    assert staged == 64 * (8 * 1 + 3 * 4)
+
+
+def test_scaler_preprocessor_fuses_into_cache(monkeypatch):
+    """A uint8 dataset + ImagePreProcessingScaler preprocessor rides
+    the cache path (scaler == wire decode, fused on device) and matches
+    the per-batch path, where the scaler runs on host."""
+    monkeypatch.setenv("DL4J_TPU_WIRE_UINT8", "1")
+    rng = np.random.RandomState(4)
+    u8 = DataSet(rng.randint(0, 256, (70, 8), dtype=np.uint8),
+                 np.eye(3, dtype=np.float32)[rng.randint(0, 3, 70)])
+
+    def run(mode):
+        it = ListDataSetIterator(u8, 16, shuffle=False)
+        it.set_preprocessor(ImagePreProcessingScaler(-0.5, 0.5))
+        net = _mln(n_in=8)
+        net.fit(it, epochs=2, ingest=mode)
+        return _flat(net.params)
+
+    np.testing.assert_allclose(run("batch"), run("cache"),
+                               rtol=2e-5, atol=1e-7)
 
 
 # ---------------------------------------------------- exact-parity: graph
@@ -211,9 +386,9 @@ def test_ingest_listener_replay_scores_match():
 def test_graph_device_cached_fit_matches_per_batch():
     ds = _data()
     a, b = _graph(), _graph()
-    a.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=2,
+    a.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
           ingest="batch")
-    b.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=2,
+    b.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
           ingest="cache")
     np.testing.assert_allclose(_flat(a.params), _flat(b.params),
                                rtol=2e-5, atol=1e-7)
@@ -228,3 +403,43 @@ def test_graph_windowed_fit_matches_per_batch():
           window=3)
     np.testing.assert_allclose(_flat(a.params), _flat(b.params),
                                rtol=2e-5, atol=1e-7)
+
+
+# -------------------------------------------- evaluation: index fast path
+
+def test_eval_argmax_fast_path_matches_slow():
+    """do_evaluation's on-device-argmax fast path (int32 indices over
+    the wire) accumulates the same confusion matrix as the full-logits
+    path, and the transfer gauge records the 4-bytes-per-example
+    saving."""
+    ds = _data(n=80)
+    net = _mln()
+    net.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=1)
+
+    class SlowEvaluation(Evaluation):
+        """Subclass defeats the `type(ev) is Evaluation` fast-path
+        check without changing any semantics."""
+
+    fast = net.do_evaluation(ListDataSetIterator(ds, 16),
+                             Evaluation())[0]
+    assert (monitor.gauge("eval_bytes_transferred", "")
+            .value(path="indices")) == 80 * 4
+    slow = net.do_evaluation(ListDataSetIterator(ds, 16),
+                             SlowEvaluation())[0]
+    assert (monitor.gauge("eval_bytes_transferred", "")
+            .value(path="logits")) == 80 * 3 * 4
+    np.testing.assert_array_equal(fast.confusion.matrix,
+                                  slow.confusion.matrix)
+    assert fast.accuracy() == slow.accuracy()
+
+
+def test_eval_top_n_falls_back_to_logits():
+    """top_n > 1 cannot be computed from an index stream: the evaluator
+    takes the full-logits path and still produces top-N accuracy."""
+    ds = _data(n=48)
+    net = _mln()
+    ev = net.do_evaluation(ListDataSetIterator(ds, 16),
+                           Evaluation(top_n=3))[0]
+    assert ev.top_n_accuracy() == 1.0    # top-3 of 3 classes is all
+    with pytest.raises(ValueError):
+        Evaluation(top_n=2).eval_class_indices([0], [0], 3)

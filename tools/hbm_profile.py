@@ -16,7 +16,13 @@ Reference analogue: the cuDNN tier's workspace/memory accounting
 (``CudnnConvolutionHelper.java:64-140``) — the reference's only
 memory-tuning surface.
 
-Usage: python tools/hbm_profile.py [resnet|lenet|vgg] [top_n]
+Usage: python tools/hbm_profile.py [resnet|lenet|vgg|gather] [top_n]
+
+``gather`` profiles the epoch-cache v2 program
+(``MultiLayerNetwork._gather_train_step``): on-device threefry epoch
+permutation, per-step row gather from the resident uint8 cache, fused
+decode to f32/bf16, scan over the epoch — the program whose HBM
+behaviour the device-resident ingest rework is accountable for.
 """
 
 import os
@@ -155,6 +161,21 @@ def compiled_step(config: str):
         batch = 256
         f = jnp.zeros((1, batch, 224, 224, 3), jnp.bfloat16)
         l = jnp.zeros((1, batch, 1000), jnp.float32)
+    elif config == "gather":
+        # epoch-cache v2: resident uint8 MNIST cache, device threefry
+        # permutation, row gather + fused decode, one-epoch scan
+        from deeplearning4j_tpu.models.lenet import lenet
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(lenet(compute_dtype="bfloat16")).init()
+        n, batch = 60000, 256
+        f = jnp.zeros((n, 784), jnp.uint8)
+        l = jnp.zeros((n, 10), jnp.float32)
+        shuffle_key = jax.random.fold_in(net._rng_key, 0xFFFFFFFF)
+        steps = n // batch
+        args = (net.params, net.updater_state, net.net_state,
+                net.iteration, f, l, net._rng_key, shuffle_key, 0, 1,
+                steps, batch, True, 0, (255.0, 1.0, 0.0))
+        return net._gather_train_step.lower(*args).compile()
     else:
         from deeplearning4j_tpu.models.lenet import lenet
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
