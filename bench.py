@@ -578,6 +578,227 @@ def bench_word2vec_fit(vocab: int = 10000, dim: int = 128,
     return result
 
 
+def bench_glove(vocab: int = 20000, dim: int = 128, batch: int = 8192,
+                triples: int = 400_000, epochs_per_window: int = 2,
+                trials: int = 3, naive: bool = True) -> dict:
+    """GloVe AdaGrad triple-updates/s through the fused dual-buffer
+    scatter path (``ops/scatter.py``): duplicate destination rows
+    collapse via sort + segment-sum, then each side's weights AND
+    accumulators land in ONE sorted-unique scatter — 2 scatters per
+    batch where the naive kernel issued 8.  The naive eight-scatter
+    reference runs in the SAME process (``naive_value``), so the
+    speedup is falsifiable on any platform regardless of tunnel
+    weather.  Triples are zipf-weighted (co-occurrence rows repeat hot
+    words), one epoch = one scan dispatch over device-resident triples.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.glove import (_glove_epoch,
+                                              _glove_epoch_fused)
+
+    rng = np.random.RandomState(0)
+    rows = np.minimum(rng.zipf(1.5, triples) - 1, vocab - 1)
+    cols = np.minimum(rng.zipf(1.5, triples) - 1, vocab - 1)
+    xs = rng.rand(triples).astype(np.float32) * 50 + 1
+    logx = jnp.asarray(np.log(xs))
+    fx = jnp.asarray(np.minimum(1.0, (xs / 100.0) ** 0.75)
+                     .astype(np.float32))
+    rows_d = jnp.asarray(rows.astype(np.int32))
+    cols_d = jnp.asarray(cols.astype(np.int32))
+    n_chunks = -(-triples // batch)
+    order = np.full(n_chunks * batch, -1, np.int32)
+    order[:triples] = rng.permutation(triples)
+    order_d = jnp.asarray(order.reshape(n_chunks, batch))
+    lr = jnp.float32(0.05)
+
+    def init_tables():
+        r = np.random.RandomState(1)
+        W = jnp.asarray((r.rand(vocab, dim).astype(np.float32) - .5) / dim)
+        Wc = jnp.asarray((r.rand(vocab, dim).astype(np.float32) - .5) / dim)
+        # distinct buffers: the naive epoch donates all eight args
+        z = lambda: jnp.zeros((vocab,), jnp.float32)
+        zh = lambda: jnp.zeros((vocab, dim), jnp.float32)
+        return W, Wc, z(), z(), zh(), zh(), z(), z()
+
+    # -- fused path ------------------------------------------------------
+    W, Wc, b, bc, hW, hWc, hb, hbc = init_tables()
+    Sr = jnp.concatenate([W, b[:, None], hW, hb[:, None]], axis=1)
+    Sc = jnp.concatenate([Wc, bc[:, None], hWc, hbc[:, None]], axis=1)
+
+    def run_fused(Sr, Sc):
+        for _ in range(epochs_per_window):
+            Sr, Sc, loss = _glove_epoch_fused(
+                Sr, Sc, rows_d, cols_d, logx, fx, order_d, lr)
+        float(np.asarray(loss))        # fetch = completion barrier
+        return Sr, Sc
+
+    # FLOPs from XLA's 1-chunk twin; HBM bytes from a HAND model (the
+    # XLA cost model charges scatters full-table traffic — the same
+    # overcount bench_word2vec documents).  Real traffic per chunk:
+    # both packed (2D+2)-wide sides gathered + scattered once per
+    # element row (aggregation only lowers the scatter side), plus the
+    # int32/f32 triple operands.
+    cost = _compiled_cost(_glove_epoch_fused.lower(
+        Sr, Sc, rows_d, cols_d, logx, fx, order_d[:1], lr).compile())
+    hand_bytes = (2 * 2 * batch * (2 * dim + 2) * 4    # gather+scatter x2 sides
+                  + batch * (4 + 4 + 4 + 4))           # rows/cols/logx/fx
+    cost["bytes"] = float(hand_bytes)
+    Sr, Sc = run_fused(Sr, Sc)         # warmup past compile
+
+    def timed() -> float:
+        nonlocal Sr, Sc
+        t0 = time.perf_counter()
+        Sr, Sc = run_fused(Sr, Sc)
+        return time.perf_counter() - t0
+
+    meas = _measured(timed, trials)
+    work = epochs_per_window * triples
+    result = {"metric": "glove_triple_updates_per_sec_per_chip",
+              "value": round(work / meas["median"], 1),
+              "unit": "triples/sec/chip", "vs_baseline": None,
+              "batch": batch, "vocab": vocab, "triples": triples,
+              "hbm_model": "hand (see bench_glove)"}
+    result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(
+        cost, epochs_per_window * n_chunks / meas["median"]))
+
+    # -- naive eight-scatter reference, same process ---------------------
+    if naive:
+        state = list(init_tables())
+
+        def run_naive():
+            nonlocal state
+            for _ in range(epochs_per_window):
+                *state, loss = _glove_epoch(*state, rows_d, cols_d,
+                                            logx, fx, order_d, lr)
+            float(np.asarray(loss))
+            return state
+
+        run_naive()                    # warmup
+
+        def timed_naive() -> float:
+            t0 = time.perf_counter()
+            run_naive()
+            return time.perf_counter() - t0
+
+        meas_n = _measured(timed_naive, trials)
+        result["naive_value"] = round(work / meas_n["median"], 1)
+        result["vs_naive_8scatter"] = round(
+            meas_n["median"] / meas["median"], 3)
+    return result
+
+
+def bench_deepwalk(n_vertices: int = 20000, n_edges: int = 200_000,
+                   walk_length: int = 40, window: int = 2,
+                   dim: int = 128, epochs_per_window: int = 2,
+                   trials: int = 3) -> dict:
+    """DeepWalk pairs/s INCLUDING walk generation — walks are generated
+    on device (threefry uniform neighbour draws over the device-resident
+    CSR) inside the same scan dispatch as the hierarchical-softmax
+    updates, so the number covers the full epoch loop, not just the
+    update kernel.  One dispatch per epoch; zero per-epoch host traffic
+    (the host path shipped the walk matrix + pair arrays every epoch)."""
+    from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+    from deeplearning4j_tpu.graph.graph import Graph
+
+    rng = np.random.RandomState(0)
+    g = Graph(n_vertices)
+    a = rng.randint(0, n_vertices, n_edges)
+    b = rng.randint(0, n_vertices, n_edges)
+    for i in range(n_edges):
+        if a[i] != b[i]:
+            g.add_edge(int(a[i]), int(b[i]), 1.0, False)
+    dw = (DeepWalk.Builder().vector_size(dim).window_size(window)
+          .seed(7).build())
+    dw.initialize(g)
+    dw.fit(g, walk_length=walk_length, epochs=1)   # warmup: CSR + compile
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        dw.fit(g, walk_length=walk_length, epochs=epochs_per_window)
+        return time.perf_counter() - t0
+
+    meas = _measured(timed, trials)
+    L = walk_length + 1
+    pairs_per_epoch = n_vertices * (L - 2 * window) * 2 * window
+    work = epochs_per_window * pairs_per_epoch
+    # hand bytes model per epoch: syn0 rows read+written once per pair,
+    # syn1 rows once per (pair x Huffman path node) at the degree-tree's
+    # mean code length, pair indices int32, plus the walk generator's
+    # CSR probes (indptr twice + one neighbour gather per step).
+    avg_len = float(np.asarray(dw._cmask_dev).sum(axis=1).mean())
+    hand_bytes = (pairs_per_epoch * (2 * dim * 4
+                                     + 2 * avg_len * dim * 4 + 8)
+                  + n_vertices * walk_length * 3 * 4)
+    result = {"metric": "deepwalk_pairs_per_sec_per_chip",
+              "value": round(work / meas["median"], 1),
+              "unit": "pairs/sec/chip", "vs_baseline": None,
+              "n_vertices": n_vertices, "walk_length": walk_length,
+              "includes_walk_generation": True,
+              "hbm_model": "hand (see bench_deepwalk)",
+              "hbm_bytes_per_epoch": round(hand_bytes, 1),
+              "hbm_gb_per_sec": round(
+                  hand_bytes * epochs_per_window / meas["median"] / 1e9,
+                  1),
+              "avg_code_len": round(avg_len, 2)}
+    result.update(_band_fields(meas, work, trials))
+    return result
+
+
+def bench_pv(mode: str = "dbow", n_docs: int = 1200,
+             doc_len: int = 500, vocab: int = 10000, dim: int = 128,
+             negative: int = 5, batch: int = 8192,
+             trials: int = 3) -> dict:
+    """END-TO-END ``ParagraphVectors.fit()`` pairs/s through the device
+    pipelines (word side: the corpus scan; label side: DBOW's label-pair
+    scan or DM's always-live label column) — the PV twin of
+    ``bench_word2vec_fit``.  Re-fits hit the pipeline cache (corpus
+    uploads once; each pass is one scan dispatch per side segment), so
+    the window times the training loop.  Pairs counted are word+label
+    pairs actually trained (fetched from the device counters)."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    rng = np.random.RandomState(0)
+    docs = [(" ".join("w%d" % w
+                      for w in rng.randint(0, vocab, doc_len)),
+             "DOC_%d" % i) for i in range(n_docs)]
+    pv = ParagraphVectors(sequence_learning_algorithm=mode,
+                          layer_size=dim, negative=negative,
+                          use_hierarchic_softmax=False, epochs=1,
+                          batch_size=batch, min_word_frequency=1,
+                          pair_generation="device")
+    pv.fit(docs)        # warmup: vocab + corpus upload + compile + pass
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        pv.fit(docs)    # pipeline cache: training loop only
+        return time.perf_counter() - t0
+
+    meas = _measured(timed, trials)
+    stats_label = getattr(pv, "_device_%s_stats" % mode)
+    word_pairs = (pv._device_pipeline_stats or {}).get("pairs_trained",
+                                                       0.0)
+    pairs = word_pairs + stats_label["pairs_trained"]
+    result = {"metric": "pv_%s_fit_end_to_end_pairs_per_sec" % mode,
+              "value": round(pairs / meas["median"], 1),
+              "unit": "pairs/sec/chip", "vs_baseline": None,
+              "n_docs": n_docs, "corpus_words": n_docs * doc_len,
+              "word_pairs_per_pass": round(word_pairs, 0),
+              "label_pairs_per_pass": round(stats_label["pairs_trained"],
+                                            0)}
+    result.update(_band_fields(meas, pairs, trials))
+    return result
+
+
+def bench_pv_dbow(**kw) -> dict:
+    return bench_pv("dbow", **kw)
+
+
+def bench_pv_dm(**kw) -> dict:
+    return bench_pv("dm", **kw)
+
+
 def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
                           d_head: int = 64, steps: int = 8,
                           trials: int = 3) -> dict:
@@ -610,11 +831,17 @@ def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
         return time.perf_counter() - t0
 
     meas = _measured(timed, trials)
+    # on-chip step duration, same machinery as the training benches:
+    # the timed window is already a blocked region (steps async
+    # dispatches closed by the loss fetch), so subtracting the tunnel
+    # round trip and dividing by steps isolates per-step chip time
+    device_ms = max(0.0, meas["median"] - _rtt_baseline()) / steps * 1e3
     work = steps * batch * seq
     tokens = work / meas["median"]
     result = {"metric": "flash_attention_train_tokens_per_sec_per_chip",
               "value": round(tokens, 1), "unit": "tokens/sec/chip",
-              "vs_baseline": None, "batch": batch, "seq": seq}
+              "vs_baseline": None, "batch": batch, "seq": seq,
+              "step_device_ms": round(device_ms, 4)}
     result.update(_band_fields(meas, work, trials))
     return result
 
@@ -925,6 +1152,16 @@ def main() -> None:
         print(json.dumps(bench_lenet(batch=32, steps=8, trials=2,
                                      pipeline=1)), flush=True)
         return
+    if "--glove-smoke" in sys.argv:
+        # CI embeddings smoke: small fused-vs-naive GloVe run, one stdout
+        # JSON line — the CI job asserts the fused rate clears the
+        # pre-aggregation plateau and that the in-process naive
+        # reference loses (platform-independent assertion).
+        print(json.dumps(bench_glove(vocab=4000, dim=64, batch=4096,
+                                     triples=100_000,
+                                     epochs_per_window=2, trials=2)),
+              flush=True)
+        return
     if "--serve" in sys.argv:
         # serving mode: ONE stdout line for the serving benchmark
         # (offered-load sweep levels go to stderr)
@@ -940,7 +1177,8 @@ def main() -> None:
     if not run_all:
         return
     for fn in (bench_resnet50, bench_vgg16, bench_lstm, bench_word2vec,
-               bench_word2vec_fit, bench_flash_attention,
+               bench_word2vec_fit, bench_glove, bench_deepwalk,
+               bench_pv_dbow, bench_pv_dm, bench_flash_attention,
                bench_fit_iterator, bench_fit_iterator_resnet,
                bench_native_ingest, bench_scaling):
         try:

@@ -20,6 +20,8 @@ pairs per chunk, one device dispatch per epoch.
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,11 +29,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..monitor import watched_jit
 from ..nlp.vocab import huffman_codes
 from ..nlp.word2vec import _hs_update
 from .api import NoEdgeHandling
 from .graph import Graph
 from .iterators import RandomWalkIterator, generate_walks
+
+
+def device_walks_enabled() -> bool:
+    """On-device walk generation escape hatch (``DL4J_TPU_DEVICE_WALKS=0``
+    forces the host ``generate_walks`` path)."""
+    return os.environ.get("DL4J_TPU_DEVICE_WALKS", "1") != "0"
 
 
 def _deepwalk_epoch(syn0, syn1, inputs, targets, pmask, points, codes,
@@ -54,6 +63,83 @@ def _deepwalk_epoch(syn0, syn1, inputs, targets, pmask, points, codes,
 
 
 _deepwalk_epoch = jax.jit(_deepwalk_epoch, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=8)
+def _walk_epoch_fn(n_vertices: int, n_edges: int, walk_length: int,
+                   window: int, B: int):
+    """Build + jit ONE dispatch covering a whole DeepWalk epoch: start
+    shuffle, random-walk generation, window-pair extraction, and the
+    hierarchical-softmax update scan — walks never cross the wire (the
+    ``nlp/device_corpus.py`` device-residency move applied to graphs;
+    the host path shipped ~n_vertices x (walk_length+1) int64 walk
+    matrices per epoch plus the pair arrays derived from them).
+
+    Walk semantics match ``iterators.generate_walks`` with
+    SELF_LOOP_ON_DISCONNECTED: per step a uniform neighbour draw
+    ``k = floor(u * deg)`` gathered from the device-resident CSR; stuck
+    walkers stay in place.  The RNG stream is device threefry (one key
+    per step), so walks differ draw-for-draw from the host MT19937
+    stream — same statistics, and deterministic under the fit seed
+    (test-asserted).  Pair extraction reproduces ``_walk_pairs``'s
+    (mid, offset) block order with static shapes.
+
+    All shape-determining config is in the lru_cache key; jitted via
+    the compile-watch so dispatch counts are observable
+    (``jit_*_total{fn="deepwalk.device_walk_epoch"}``)."""
+    L = walk_length + 1
+    mids = np.arange(window, L - window)
+    offs = np.concatenate(
+        [np.arange(-window, 0), np.arange(1, window + 1)]).astype(np.int64)
+    M = mids.size
+    n_pairs = n_vertices * M * 2 * window
+    n_chunks = max(1, -(-n_pairs // B))
+    pad = n_chunks * B - n_pairs
+
+    def epoch(syn0, syn1, indptr, indices, points, codes, cmask, key,
+              lr):
+        kperm, kwalk = jax.random.split(key)
+        starts = jax.random.permutation(
+            kperm, n_vertices).astype(jnp.int32)
+        step_keys = jax.random.split(kwalk, walk_length)
+
+        def wstep(cur, kstep):
+            deg = indptr[cur + 1] - indptr[cur]
+            u = jax.random.uniform(kstep, (n_vertices,))
+            k = jnp.minimum((u * deg.astype(jnp.float32))
+                            .astype(jnp.int32),
+                            jnp.maximum(deg - 1, 0))
+            pos = jnp.minimum(indptr[cur] + k, n_edges - 1)
+            nxt = jnp.where(deg == 0, cur, indices[pos])
+            return nxt, nxt
+
+        _, rest = jax.lax.scan(wstep, starts, step_keys)
+        walks = jnp.concatenate([starts[None, :], rest], axis=0).T
+        # _walk_pairs block order: for mid, for off -> one (n,) block
+        ins = jnp.broadcast_to(
+            walks[:, jnp.asarray(mids)].T[:, None, :],
+            (M, 2 * window, n_vertices)).reshape(-1)
+        tgts = jnp.transpose(
+            walks[:, jnp.asarray(mids[:, None] + offs[None, :])],
+            (1, 2, 0)).reshape(-1)
+        pmask = (jnp.arange(n_chunks * B) < n_pairs).astype(jnp.float32)
+        inputs = jnp.pad(ins, (0, pad)).reshape(n_chunks, B)
+        targets = jnp.pad(tgts, (0, pad)).reshape(n_chunks, B)
+
+        def body(carry, xs):
+            syn0, syn1, loss_sum = carry
+            bi, bt, pm = xs
+            syn0, syn1, loss = _hs_update(syn0, syn1, bi, points[bt],
+                                          codes[bt], cmask[bt], pm, lr)
+            return (syn0, syn1, loss_sum + loss), None
+
+        (syn0, syn1, loss), _ = jax.lax.scan(
+            body, (syn0, syn1, jnp.float32(0.0)),
+            (inputs, targets, pmask.reshape(n_chunks, B)))
+        return syn0, syn1, loss
+
+    return watched_jit(epoch, name="deepwalk.device_walk_epoch",
+                       donate_argnums=(0, 1))
 
 
 class GraphHuffman:
@@ -148,6 +234,13 @@ class DeepWalk(GraphVectors):
         self.syn1: Optional[jnp.ndarray] = None
         self.graph = None
         self._cum_loss = 0.0
+        # device-resident CSR for on-device walk generation (uploaded
+        # once per graph) + lifetime pass counter for the walk RNG
+        self._csr_graph = None
+        self._indptr_dev = None
+        self._indices_dev = None
+        self._n_edges = 0
+        self._walk_passes = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -201,6 +294,10 @@ class DeepWalk(GraphVectors):
             self.initialize(graph)
         if graph is not None:
             self.graph = graph
+        if (iterator is None and device_walks_enabled()
+                and self._device_walk_eligible(walk_length)):
+            self._fit_device_walks(walk_length, epochs)
+            return self
         rng = np.random.default_rng(self.seed)
         for _ in range(epochs):
             if iterator is not None:
@@ -214,6 +311,50 @@ class DeepWalk(GraphVectors):
                     no_edge=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
             self._train_walks(walks)
         return self
+
+    def _device_walk_eligible(self, walk_length: int) -> bool:
+        """The device path covers the default ``fit(graph)`` route:
+        uniform walks, at least one edge (the empty-CSR gather has no
+        rows to pull from), and a window that yields pairs at all."""
+        if self.graph is None:
+            return False
+        indptr, indices, _ = self.graph.csr()
+        if indices.size == 0:
+            return False
+        return (walk_length + 1) - 2 * self.window_size > 0
+
+    def _ensure_csr_device(self) -> None:
+        if self._csr_graph is self.graph and self._indptr_dev is not None:
+            return
+        indptr, indices, _ = self.graph.csr()
+        self._indptr_dev = jnp.asarray(indptr.astype(np.int32))
+        self._indices_dev = jnp.asarray(indices.astype(np.int32))
+        self._n_edges = int(indices.size)
+        self._csr_graph = self.graph
+
+    def _fit_device_walks(self, walk_length: int, epochs: int) -> None:
+        """Epochs as back-to-back single-dispatch scans — walk
+        generation, pair extraction, and updates all on device; the one
+        loss fetch after the epoch loop is the completion barrier."""
+        self._ensure_csr_device()
+        n = int(self.syn0.shape[0])
+        B = int(min(self.batch_size, max(64, 2 * n)))
+        fn = _walk_epoch_fn(n, self._n_edges, int(walk_length),
+                            self.window_size, B)
+        base = jax.random.PRNGKey(
+            self.seed if self.seed is not None
+            else int(np.random.randint(0, 2**31 - 1)))
+        losses = []
+        for _ in range(epochs):
+            key = jax.random.fold_in(base, self._walk_passes)
+            self._walk_passes += 1
+            self.syn0, self.syn1, loss = fn(
+                self.syn0, self.syn1, self._indptr_dev,
+                self._indices_dev, self._points_dev, self._codes_dev,
+                self._cmask_dev, key, jnp.float32(self.learning_rate))
+            losses.append(loss)
+        for loss in losses:
+            self._cum_loss += float(np.asarray(loss))
 
     def _walk_pairs(self, walks: np.ndarray) -> Tuple[np.ndarray,
                                                       np.ndarray]:

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.scatter import scatter_add_agg
 from .word2vec import _hs_update, _ns_update
 
 Array = jax.Array
@@ -326,7 +327,8 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     logits = jnp.einsum("bd,bld->bl", h, w)
                     g = ((1.0 - cds - jax.nn.sigmoid(logits))
                          * cmk * exmask[:, None] * alpha)
-                    syn1 = syn1.at[pts].add(g[:, :, None] * h[:, None, :])
+                    syn1 = scatter_add_agg(
+                        syn1, pts, g[:, :, None] * h[:, None, :])
                     d_syn0 = jnp.einsum("bl,bld->bd", g, w)
                     loss = loss - jnp.sum(
                         jax.nn.log_sigmoid((1.0 - 2.0 * cds) * logits)
@@ -335,8 +337,8 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     logits = jnp.einsum("bcd,bld->bcl", hc, w)
                     g = ((1.0 - cds[:, None, :] - jax.nn.sigmoid(logits))
                          * cmk[:, None, :] * pmask[:, :, None] * alpha)
-                    syn1 = syn1.at[pts].add(
-                        jnp.einsum("bcl,bcd->bld", g, hc))
+                    syn1 = scatter_add_agg(
+                        syn1, pts, jnp.einsum("bcl,bcd->bld", g, hc))
                     d_syn0 = jnp.einsum("bcl,bld->bcd", g, w)
                     loss = loss - jnp.sum(
                         jax.nn.log_sigmoid((1.0 - 2.0 * cds[:, None, :])
@@ -358,8 +360,8 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     logits = jnp.einsum("bd,bkd->bk", h, w)
                     g = ((lbl[None, :] - jax.nn.sigmoid(logits))
                          * tmask * exmask[:, None] * alpha)
-                    syn1neg = syn1neg.at[tgt].add(
-                        g[:, :, None] * h[:, None, :])
+                    syn1neg = scatter_add_agg(
+                        syn1neg, tgt, g[:, :, None] * h[:, None, :])
                     dns = jnp.einsum("bk,bkd->bd", g, w)
                     d_syn0 = dns if d_syn0 is None else d_syn0 + dns
                     loss = loss - jnp.sum(
@@ -370,8 +372,8 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
                     logits = jnp.einsum("bcd,bkd->bck", hc, w)
                     g = ((lbl[None, None, :] - jax.nn.sigmoid(logits))
                          * tmask[:, None, :] * pmask[:, :, None] * alpha)
-                    syn1neg = syn1neg.at[tgt].add(
-                        jnp.einsum("bck,bcd->bkd", g, hc))
+                    syn1neg = scatter_add_agg(
+                        syn1neg, tgt, jnp.einsum("bck,bcd->bkd", g, hc))
                     dns = jnp.einsum("bck,bkd->bcd", g, w)
                     d_syn0 = dns if d_syn0 is None else d_syn0 + dns
                     loss = loss - jnp.sum(
@@ -382,11 +384,11 @@ def _epoch_fn(window: int, negative: int, use_hs: bool, span: int,
             if cbow:
                 # the (b, d) example gradient fans out to every live
                 # context cell (un-divided — word2vec.c neu1e semantics)
-                syn0 = syn0.at[words].add(
-                    d_syn0[:, None, :] * pmask[:, :, None])
+                syn0 = scatter_add_agg(
+                    syn0, words, d_syn0[:, None, :] * pmask[:, :, None])
                 trained = jnp.sum(exmask)
             else:
-                syn0 = syn0.at[words].add(d_syn0)
+                syn0 = scatter_add_agg(syn0, words, d_syn0)
                 trained = jnp.sum(pmask)
             return (syn0, syn1, syn1neg, pair_count + trained,
                     loss_sum + loss), None

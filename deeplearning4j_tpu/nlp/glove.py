@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.scatter import aggregation_enabled, fused_adagrad_dual
 from .lookup_table import InMemoryLookupTable
 from .vocab import VocabCache, VocabConstructor
 from .word2vec import SequenceVectors
@@ -26,8 +27,12 @@ def _glove_update(W: Array, Wc: Array, b: Array, bc: Array, hW: Array,
                   hWc: Array, hb: Array, hbc: Array, rows: Array,
                   cols: Array, logx: Array, fx: Array, mask: Array,
                   lr: Array):
-    """One AdaGrad batch over co-occurrence triples (shared by the
-    jitted per-batch ``_glove_step`` and the on-device epoch scan).
+    """One AdaGrad batch over co-occurrence triples — the NAIVE
+    eight-scatter reference path (four accumulator bumps, four scaled
+    weight deltas).  The production path is ``_glove_update_fused``
+    below, parity-tested against this; this form is kept as the
+    documented semantics contract, the parity oracle, and the
+    ``Glove.use_fused_scatter = False`` escape hatch.
 
     W/Wc: word and context embeddings; b/bc biases; h*: AdaGrad
     accumulators.  Standard GloVe gradients with scatter-add updates.
@@ -52,6 +57,58 @@ def _glove_update(W: Array, Wc: Array, b: Array, bc: Array, hW: Array,
 
 
 _glove_step = jax.jit(_glove_update, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+
+
+def _glove_update_fused(Sr: Array, Sc: Array, rows: Array, cols: Array,
+                        logx: Array, fx: Array, mask: Array, lr: Array):
+    """The production AdaGrad batch: TWO fused table updates instead of
+    eight scatters (``ops/scatter.py``, the scatter-row economics the
+    word2vec tier profiled at ~7M scatter rows/s — scatter rows, not
+    FLOPs, bound this kernel).
+
+    State is the packed dual-buffer layout: ``Sr`` (V, 2D+2) =
+    ``[W | b | hW | hb]`` for the word side, ``Sc`` likewise for the
+    context side (``[Wc | bc | hWc | hbc]``).  Each side's batch
+    collapses duplicate destination rows (hot words repeat heavily in
+    co-occurrence batches) with a sort + segment-sum, then lands weight
+    deltas AND accumulator bumps in ONE sorted-unique scatter
+    (:func:`~deeplearning4j_tpu.ops.scatter.fused_adagrad_dual`).
+    Gradient math, the read-after-batch-accumulator AdaGrad semantics,
+    and the loss are identical to ``_glove_update`` (parity-tested,
+    incl. duplicate-heavy batches)."""
+    D = Sr.shape[1] // 2 - 1
+    ri, ci = Sr[rows], Sc[cols]                        # (B, 2D+2)
+    wi, bi = ri[:, :D], ri[:, D]
+    wj, bj = ci[:, :D], ci[:, D]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + bi + bj - logx
+    g = fx * diff * mask                               # (B,)
+    loss = 0.5 * jnp.sum(fx * diff * diff * mask)
+    grad_r = jnp.concatenate([g[:, None] * wj, g[:, None]], axis=1)
+    grad_c = jnp.concatenate([g[:, None] * wi, g[:, None]], axis=1)
+    Sr = fused_adagrad_dual(Sr, rows, grad_r, lr)
+    Sc = fused_adagrad_dual(Sc, cols, grad_c, lr)
+    return Sr, Sc, loss
+
+
+def _glove_epoch_fused(Sr, Sc, rows_all, cols_all, logx_all, fx_all,
+                       order, lr):
+    """Fused twin of ``_glove_epoch``: same one-dispatch-per-epoch scan
+    over device-resident triples, with the packed dual-buffer state and
+    the two-scatter update body."""
+    def body(carry, idx):
+        Sr, Sc, loss_sum = carry
+        mask = (idx >= 0).astype(jnp.float32)
+        sel = jnp.maximum(idx, 0)
+        Sr, Sc, loss = _glove_update_fused(
+            Sr, Sc, rows_all[sel], cols_all[sel], logx_all[sel],
+            fx_all[sel], mask, lr)
+        return (Sr, Sc, loss_sum + loss), None
+    (Sr, Sc, loss), _ = jax.lax.scan(
+        body, (Sr, Sc, jnp.float32(0.0)), order)
+    return Sr, Sc, loss
+
+
+_glove_epoch_fused = jax.jit(_glove_epoch_fused, donate_argnums=(0, 1))
 
 
 def _glove_epoch(W, Wc, b, bc, hW, hWc, hb, hbc, rows_all, cols_all,
@@ -92,6 +149,14 @@ class Glove(SequenceVectors):
     #: final-epoch weighted-least-squares loss of the last fit (None
     #: until a fit trains at least one epoch on a non-empty cooc set)
     last_epoch_loss: Optional[float] = None
+
+    #: route AdaGrad batches through the two-scatter fused dual-buffer
+    #: path (``ops/scatter.py``); False falls back to the naive
+    #: eight-scatter reference kernel (same math — parity-tested).
+    #: None = auto: fused where scatter rows are the cost (TPU), naive
+    #: where the aggregation pass costs more than CPU's cheap scatters
+    #: save (``aggregation_enabled()`` — same gate, same env override)
+    use_fused_scatter: Optional[bool] = None
 
     def __init__(self, x_max: float = 100.0, alpha: float = 0.75,
                  symmetric: bool = True, **kwargs):
@@ -210,13 +275,32 @@ class Glove(SequenceVectors):
         logx_d = jnp.asarray(logx)
         fx_d = jnp.asarray(fx)
         order = np.arange(n)
-        for _ in range(self.epochs):
-            self._rng.shuffle(order)
-            padded = np.full(n_chunks * B, -1, np.int32)
-            padded[:n] = order
-            (W, Wc, b, bc, hW, hWc, hb, hbc, ep_loss) = _glove_epoch(
-                W, Wc, b, bc, hW, hWc, hb, hbc, rows_d, cols_d, logx_d,
-                fx_d, jnp.asarray(padded.reshape(n_chunks, B)), lr)
+        fused = (self.use_fused_scatter if self.use_fused_scatter
+                 is not None else aggregation_enabled())
+        if fused:
+            # packed dual-buffer state: [W | b | hW | hb] per side, so
+            # each side's AdaGrad batch is ONE sorted-unique scatter
+            Sr = jnp.concatenate([W, b[:, None], hW, hb[:, None]], axis=1)
+            Sc = jnp.concatenate([Wc, bc[:, None], hWc, hbc[:, None]],
+                                 axis=1)
+            for _ in range(self.epochs):
+                self._rng.shuffle(order)
+                padded = np.full(n_chunks * B, -1, np.int32)
+                padded[:n] = order
+                Sr, Sc, ep_loss = _glove_epoch_fused(
+                    Sr, Sc, rows_d, cols_d, logx_d, fx_d,
+                    jnp.asarray(padded.reshape(n_chunks, B)), lr)
+            W, b = Sr[:, :D], Sr[:, D]
+            Wc, bc = Sc[:, :D], Sc[:, D]
+        else:
+            for _ in range(self.epochs):
+                self._rng.shuffle(order)
+                padded = np.full(n_chunks * B, -1, np.int32)
+                padded[:n] = order
+                (W, Wc, b, bc, hW, hWc, hb, hbc, ep_loss) = _glove_epoch(
+                    W, Wc, b, bc, hW, hWc, hb, hbc, rows_d, cols_d,
+                    logx_d, fx_d,
+                    jnp.asarray(padded.reshape(n_chunks, B)), lr)
         #: monitored loss: the FINAL epoch's weighted-least-squares sum
         #: (the reference logs per-epoch GloVe loss); fetching it is also
         #: the fit's device completion barrier

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.scatter import scatter_add_agg
 from .lookup_table import InMemoryLookupTable
 from .tokenization import DefaultTokenizerFactory, TokenizerFactory
 from .vocab import VocabCache, VocabConstructor, build_huffman_tree
@@ -56,8 +57,12 @@ def _hs_update(syn0: Array, syn1: Array, inputs: Array, points: Array,
     mask = code_mask * pair_mask[:, None]
     g = (1.0 - codes - jax.nn.sigmoid(logits)) * mask * lr
     dh = jnp.einsum("bl,bld->bd", g, w)
-    syn1 = syn1.at[points].add(g[:, :, None] * h[:, None, :])
-    syn0 = syn0.at[inputs].add(dh)
+    # unique-row aggregated scatters (ops/scatter.py): Huffman paths
+    # share inner nodes heavily (every pair hits the root), so the
+    # duplicate-row sums collapse before ONE sorted-unique scatter per
+    # table; g is already masked, so dead rows carry zero payload
+    syn1 = scatter_add_agg(syn1, points, g[:, :, None] * h[:, None, :])
+    syn0 = scatter_add_agg(syn0, inputs, dh)
     # Monitored loss: BCE over the path, sign-folded logits.
     loss = -jnp.sum(jax.nn.log_sigmoid((1.0 - 2.0 * codes) * logits) * mask)
     return syn0, syn1, loss
@@ -82,8 +87,11 @@ def _ns_update(syn0: Array, syn1neg: Array, inputs: Array, targets: Array,
     mask = target_mask * pair_mask[:, None]
     g = (labels[None, :] - jax.nn.sigmoid(logits)) * mask * lr
     dh = jnp.einsum("bk,bkd->bd", g, w)
-    syn1neg = syn1neg.at[targets].add(g[:, :, None] * h[:, None, :])
-    syn0 = syn0.at[inputs].add(dh)
+    # aggregated scatters: negative draws repeat hot unigram rows, and
+    # inputs repeat within a window's pair block (ops/scatter.py)
+    syn1neg = scatter_add_agg(syn1neg, targets,
+                              g[:, :, None] * h[:, None, :])
+    syn0 = scatter_add_agg(syn0, inputs, dh)
     loss = -jnp.sum(jax.nn.log_sigmoid(
         jnp.where(labels[None, :] > 0, logits, -logits)) * mask)
     return syn0, syn1neg, loss

@@ -510,11 +510,13 @@ class MultiLayerNetwork:
                 last = i
         return last
 
-    def _tbptt_step_for(self, adv: int):
-        """Truncated-BPTT window step (reference ``doTruncatedBPTT:1138``):
-        one fwd+bwd+update over a ``tbptt_fwd_length`` window with carries
-        in from the previous window, gradients stopped at the window
-        boundary.
+    def _tbptt_window_loss(self, adv: int, carries):
+        """Loss closure for ONE truncated-BPTT window with ``carries`` in
+        (gradients stopped at the window boundary): ``loss(p, ns, f, l,
+        fm, lm, r) -> (loss, (new_state, new_carries))``.  Shared by the
+        single-device window step (:meth:`_tbptt_step_for`) and
+        ``ParallelWrapper``'s per-worker round, so both train the exact
+        same windowed program.
 
         ``adv`` > 0 reproduces the reference's ``tbptt_back_length <
         fwd`` semantics exactly (``LSTMHelpers`` truncated backward loop):
@@ -524,55 +526,64 @@ class MultiLayerNetwork:
         ALL window steps while the recurrent trunk sees only the trailing
         ``back`` steps, matching the reference's per-layer truncation.
         """
+        last_rec = self._last_stateful_recurrent()
+        carries = jax.lax.stop_gradient(carries)
+
+        def loss(p, ns, f, l, fm, lm, r):
+            if adv == 0:
+                return self._loss_fn(p, ns, f, l, fm, lm, r, True,
+                                     carries=carries)
+            rA = rB = None
+            if r is not None:
+                rA = jax.random.fold_in(r, 0)
+                rB = jax.random.fold_in(r, 1)
+            fmA = None if fm is None else fm[:, :adv]
+            # leading steps: recurrent trunk, gradients stopped
+            trunk, _, mid = self._forward(
+                p, ns, f[:, :adv], train=True, rng=rA, mask=fmA,
+                carries=carries, to_layer=last_rec)
+            trunk = jax.lax.stop_gradient(trunk)
+            mid = jax.lax.stop_gradient(mid)
+            lmA = None if lm is None else lm[:, :adv]
+            lmB = None if lm is None else lm[:, adv:]
+            loss_a, _ = self._loss_fn(
+                p, ns, trunk, l[:, :adv], fmA, lmA, rA, True,
+                from_layer=last_rec + 1)
+            loss_b, aux = self._loss_fn(
+                p, ns, f[:, adv:], l[:, adv:],
+                None if fm is None else fm[:, adv:], lmB, rB,
+                True, carries=mid)
+            # Masked scores normalize by each segment's own mask
+            # count; recombine so the window averages over the
+            # TOTAL active steps, matching the adv == 0 path.
+            eff_a = lmA if lmA is not None else fmA
+            eff_b = (lmB if lmB is not None
+                     else (None if fm is None else fm[:, adv:]))
+            if (self.conf.conf.mini_batch and eff_a is not None
+                    and eff_b is not None):
+                ca = jnp.sum(eff_a)
+                cb = jnp.sum(eff_b)
+                total = (loss_a * ca + loss_b * cb) / \
+                    jnp.maximum(ca + cb, 1.0)
+                return total, aux
+            return loss_a + loss_b, aux
+
+        return loss
+
+    def _tbptt_step_for(self, adv: int):
+        """Truncated-BPTT window step (reference ``doTruncatedBPTT:1138``):
+        one fwd+bwd+update over a ``tbptt_fwd_length`` window with carries
+        in from the previous window, gradients stopped at the window
+        boundary (window-loss semantics: :meth:`_tbptt_window_loss`).
+        """
         if adv not in self._tbptt_step_cache:
-            last_rec = self._last_stateful_recurrent()
 
             def step(params, updater_state, net_state, carries, iteration,
                      features, labels, features_mask, labels_mask,
                      base_rng):
                 rng = (jax.random.fold_in(base_rng, iteration)
                        if base_rng is not None else None)
-                carries = jax.lax.stop_gradient(carries)
-
-                def loss(p, ns, f, l, fm, lm, r):
-                    if adv == 0:
-                        return self._loss_fn(p, ns, f, l, fm, lm, r, True,
-                                             carries=carries)
-                    rA = rB = None
-                    if r is not None:
-                        rA = jax.random.fold_in(r, 0)
-                        rB = jax.random.fold_in(r, 1)
-                    fmA = None if fm is None else fm[:, :adv]
-                    # leading steps: recurrent trunk, gradients stopped
-                    trunk, _, mid = self._forward(
-                        p, ns, f[:, :adv], train=True, rng=rA, mask=fmA,
-                        carries=carries, to_layer=last_rec)
-                    trunk = jax.lax.stop_gradient(trunk)
-                    mid = jax.lax.stop_gradient(mid)
-                    lmA = None if lm is None else lm[:, :adv]
-                    lmB = None if lm is None else lm[:, adv:]
-                    loss_a, _ = self._loss_fn(
-                        p, ns, trunk, l[:, :adv], fmA, lmA, rA, True,
-                        from_layer=last_rec + 1)
-                    loss_b, aux = self._loss_fn(
-                        p, ns, f[:, adv:], l[:, adv:],
-                        None if fm is None else fm[:, adv:], lmB, rB,
-                        True, carries=mid)
-                    # Masked scores normalize by each segment's own mask
-                    # count; recombine so the window averages over the
-                    # TOTAL active steps, matching the adv == 0 path.
-                    eff_a = lmA if lmA is not None else fmA
-                    eff_b = (lmB if lmB is not None
-                             else (None if fm is None else fm[:, adv:]))
-                    if (self.conf.conf.mini_batch and eff_a is not None
-                            and eff_b is not None):
-                        ca = jnp.sum(eff_a)
-                        cb = jnp.sum(eff_b)
-                        total = (loss_a * ca + loss_b * cb) / \
-                            jnp.maximum(ca + cb, 1.0)
-                        return total, aux
-                    return loss_a + loss_b, aux
-
+                loss = self._tbptt_window_loss(adv, carries)
                 (data_loss, (new_state, new_carries)), grads = \
                     jax.value_and_grad(loss, has_aux=True)(
                         params, net_state, features, labels, features_mask,

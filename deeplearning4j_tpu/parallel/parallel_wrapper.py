@@ -113,6 +113,13 @@ class ParallelWrapper:
         Single XLA program; collectives ride the mesh."""
         net = self.model
         avg_updaters = self.average_updaters
+        # MultiLayerNetwork tBPTT config: each worker's local step runs
+        # the same windowed program as single-device _fit_tbptt (window
+        # slicing, carried recurrent state, back<fwd trunk truncation)
+        # instead of full-sequence BPTT — required for the n-vs-1
+        # equality guarantee on recurrent nets.
+        tbptt = (not self._is_graph
+                 and net.conf.backprop_type == "tbptt")
 
         def local_round(params, updater_state, net_state, iteration,
                         features, labels, fmask, lmask, base_rng, wire):
@@ -149,6 +156,34 @@ class ParallelWrapper:
                                   for fi, w in zip(f, wire))
                     else:
                         f = ingest.device_decode(f, wire)
+                if tbptt:
+                    # the single-device windowed program, per worker:
+                    # slice tbptt_fwd_length windows, carry recurrent
+                    # state, stop gradients at window boundaries
+                    # (back<fwd trunk truncation included via
+                    # _tbptt_window_loss); iteration advances per window
+                    window = net.conf.tbptt_fwd_length
+                    back = net.conf.tbptt_back_length or window
+                    T = f.shape[1]
+                    carries = net._init_carries(f.shape[0])
+                    score = jnp.float32(0.0)
+                    for start in range(0, T, window):
+                        stop = min(start + window, T)
+                        adv = max(0, (stop - start) - back)
+                        fm_w = None if fm is None else fm[:, start:stop]
+                        lm_w = None if lm is None else lm[:, start:stop]
+                        rng = jax.random.fold_in(
+                            jax.random.fold_in(base_rng, it), widx)
+                        wloss = net._tbptt_window_loss(adv, carries)
+                        (data_loss, (net_state, carries)), grads = \
+                            jax.value_and_grad(wloss, has_aux=True)(
+                                params, net_state, f[:, start:stop],
+                                l[:, start:stop], fm_w, lm_w, rng)
+                        params, updater_state = net._apply_updates(
+                            params, updater_state, grads, it)
+                        score = data_loss + net._reg_score(params)
+                        it = it + 1
+                    return (params, updater_state, net_state, it), score
                 rng = jax.random.fold_in(
                     jax.random.fold_in(base_rng, it), widx)
                 (data_loss, aux), grads = jax.value_and_grad(

@@ -1,0 +1,357 @@
+"""Scatter-row economics (``ops/scatter.py``): exact parity of the
+unique-row aggregated scatter path against the naive duplicate-row
+scatter, at three levels —
+
+- the primitives (``aggregate_rows`` / ``scatter_add_agg`` /
+  ``fused_adagrad_dual``), including duplicate-heavy batches, grid
+  (B, L) index shapes, zero-payload masking, and bf16;
+- every embedding trainer that rides them: GloVe (fused dual-buffer
+  AdaGrad vs the eight-scatter reference kernel), the DeepWalk /
+  word2vec hierarchical-softmax kernel, and the PV negative-sampling
+  kernel;
+- the DeepWalk on-device walk generator: bit-exact determinism under a
+  fixed fit RNG, and the one-dispatch-per-epoch contract via the
+  watched-jit counters.
+
+Aggregation reassociates each destination row's float sum (sorted
+segment order instead of batch order), so trainer-level parity is to
+tight float32 tolerance, not bit equality; bf16 tolerance scales with
+the dtype's epsilon times the duplicate depth.
+
+``aggregation_enabled`` resolves at TRACE time, so the env-flip parity
+tests call the kernels eagerly (un-jitted) — flipping the env under an
+already-compiled jit would silently reuse the old trace.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning4j_tpu.ops.scatter import (  # noqa: E402
+    aggregate_rows, aggregation_enabled, fused_adagrad_dual, pack_dual,
+    scatter_add_agg, unpack_dual)
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _dup_heavy(rng, B, V):
+    """Index vector with heavy duplication: zipf-style concentration on
+    a few hot rows (the GloVe hot-word / Huffman-root regime)."""
+    hot = rng.randint(0, max(V // 8, 1), B)
+    cold = rng.randint(0, V, B)
+    return np.where(rng.rand(B) < 0.7, hot, cold).astype(np.int32)
+
+
+# ------------------------------------------------------------ primitives
+
+def test_aggregate_rows_sorted_unique_with_sentinels():
+    idx = jnp.asarray(np.array([3, 1, 3, 1, 1, 7], np.int32))
+    vals = jnp.asarray(np.arange(6, dtype=np.float32) + 1.0)
+    dest, sums = aggregate_rows(idx, vals)
+    dest, sums = np.asarray(dest), np.asarray(sums)
+    assert dest.shape == (6,) and sums.shape == (6,)
+    # three unique rows ascending, then int32-max sentinels
+    assert dest[:3].tolist() == [1, 3, 7]
+    assert (dest[3:] == _I32_MAX).all()
+    # per-row sums: row 1 <- vals[1,3,4]; row 3 <- vals[0,2]; row 7 <- [5]
+    np.testing.assert_allclose(sums[:3], [2 + 4 + 5, 1 + 3, 6])
+    np.testing.assert_allclose(sums[3:], 0.0)  # sentinel slots inert
+
+
+def test_aggregate_rows_multi_payload_matches_bincount():
+    rng = np.random.RandomState(0)
+    B, V, D = 512, 40, 7
+    idx = _dup_heavy(rng, B, V)
+    a = rng.randn(B, D).astype(np.float32)
+    b = rng.randn(B).astype(np.float32)
+    dest, sa, sb = aggregate_rows(jnp.asarray(idx), jnp.asarray(a),
+                                  jnp.asarray(b))
+    dest, sa, sb = np.asarray(dest), np.asarray(sa), np.asarray(sb)
+    live = dest < V
+    ref_b = np.bincount(idx, weights=b.astype(np.float64), minlength=V)
+    np.testing.assert_allclose(sb[live], ref_b[dest[live]], rtol=1e-5,
+                               atol=1e-6)
+    for d in range(D):
+        ref = np.bincount(idx, weights=a[:, d].astype(np.float64),
+                          minlength=V)
+        np.testing.assert_allclose(sa[live, d], ref[dest[live]],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_scatter_add_agg_parity_duplicate_heavy():
+    rng = np.random.RandomState(1)
+    B, V, D = 2048, 50, 16
+    idx = jnp.asarray(_dup_heavy(rng, B, V))
+    vals = jnp.asarray(rng.randn(B, D).astype(np.float32))
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    agg = scatter_add_agg(table, idx, vals, aggregate=True)
+    naive = scatter_add_agg(table, idx, vals, aggregate=False)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(naive),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_scatter_add_agg_grid_indices_and_masking():
+    """(B, L) Huffman-path-style index grids, with masked (zero-payload)
+    cells carrying an arbitrary in-range index — they must be inert."""
+    rng = np.random.RandomState(2)
+    B, L, V, D = 128, 6, 30, 8
+    # rows [0, 5) are referenced ONLY from masked cells — they must
+    # come out exactly zero below
+    idx = rng.randint(5, V, (B, L)).astype(np.int32)
+    mask = (rng.rand(B, L) < 0.6).astype(np.float32)
+    idx[mask == 0.0] = rng.randint(0, 5, int((mask == 0.0).sum()))
+    vals = rng.randn(B, L, D).astype(np.float32) * mask[:, :, None]
+    agg = scatter_add_agg(jnp.zeros((V, D)), jnp.asarray(idx),
+                          jnp.asarray(vals), aggregate=True)
+    naive = scatter_add_agg(jnp.zeros((V, D)), jnp.asarray(idx),
+                            jnp.asarray(vals), aggregate=False)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(naive),
+                               rtol=1e-5, atol=1e-6)
+    # masked cells contributed nothing: rows referenced ONLY by masked
+    # cells stay zero
+    assert np.abs(np.asarray(agg)[:5]).max() == 0.0
+
+
+def test_scatter_add_agg_bf16_parity():
+    """bf16 tables/payloads: both paths agree within a tolerance scaled
+    by the dtype's epsilon times the per-row duplicate depth."""
+    rng = np.random.RandomState(3)
+    B, V, D = 2048, 32, 8
+    idx = _dup_heavy(rng, B, V)
+    vals32 = rng.randn(B, D).astype(np.float32)
+    vals = jnp.asarray(vals32).astype(jnp.bfloat16)
+    table = jnp.zeros((V, D), jnp.bfloat16)
+    agg = scatter_add_agg(table, jnp.asarray(idx), vals, aggregate=True)
+    naive = scatter_add_agg(table, jnp.asarray(idx), vals,
+                            aggregate=False)
+    assert agg.dtype == jnp.bfloat16 and naive.dtype == jnp.bfloat16
+    # worst-case per-row accumulation error: depth * eps_bf16 * |sum|
+    depth = np.bincount(idx, minlength=V).max()
+    ref = np.zeros((V, D), np.float64)
+    np.add.at(ref, idx, vals32.astype(np.float64))
+    tol = depth * 2.0 ** -8 * max(1.0, np.abs(ref).max())
+    np.testing.assert_allclose(np.asarray(agg, np.float32),
+                               np.asarray(naive, np.float32), atol=tol)
+    np.testing.assert_allclose(np.asarray(agg, np.float32), ref,
+                               atol=tol)
+
+
+def test_fused_adagrad_dual_matches_naive_two_scatter():
+    """Read-after-batch semantics: every duplicate's weight delta is
+    scaled by the accumulator AFTER the whole batch's squared-gradient
+    sum — exactly what ``h.at[i].add(g*g)`` then ``h[i]`` computes."""
+    rng = np.random.RandomState(4)
+    B, V, P = 1024, 40, 12
+    idx = _dup_heavy(rng, B, V)
+    g = rng.randn(B, P).astype(np.float32)
+    w = rng.randn(V, P).astype(np.float32)
+    h = np.abs(rng.randn(V, P)).astype(np.float32)
+    lr = 0.05
+    state = fused_adagrad_dual(pack_dual(jnp.asarray(w), jnp.asarray(h)),
+                               jnp.asarray(idx), jnp.asarray(g),
+                               jnp.float32(lr))
+    w_f, h_f = (np.asarray(x) for x in unpack_dual(state))
+    h_ref = jnp.asarray(h).at[jnp.asarray(idx)].add(
+        jnp.asarray(g) * jnp.asarray(g))
+    w_ref = jnp.asarray(w).at[jnp.asarray(idx)].add(
+        -lr * jnp.asarray(g) / jnp.sqrt(h_ref[jnp.asarray(idx)] + 1e-8))
+    np.testing.assert_allclose(h_f, np.asarray(h_ref), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(w_f, np.asarray(w_ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_adagrad_dual_1d_bias_tables():
+    rng = np.random.RandomState(5)
+    B, V = 512, 25
+    idx = _dup_heavy(rng, B, V)
+    g = rng.randn(B, 1).astype(np.float32)
+    b = rng.randn(V).astype(np.float32)
+    hb = np.abs(rng.randn(V)).astype(np.float32)
+    state = fused_adagrad_dual(
+        pack_dual(jnp.asarray(b), jnp.asarray(hb)), jnp.asarray(idx),
+        jnp.asarray(g), jnp.float32(0.1))
+    b_f, hb_f = (np.asarray(x) for x in unpack_dual(state, squeeze=True))
+    hb_ref = hb.copy()
+    np.add.at(hb_ref, idx, (g[:, 0] ** 2))
+    b_ref = b.copy()
+    np.add.at(b_ref, idx, -0.1 * g[:, 0] / np.sqrt(
+        hb_ref[idx] + 1e-8))
+    np.testing.assert_allclose(hb_f, hb_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(b_f, b_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_aggregation_enabled_gate(monkeypatch):
+    """Resolution order: explicit override > env var > backend default
+    (TPU on, everything else off)."""
+    monkeypatch.delenv("DL4J_TPU_SCATTER_AGG", raising=False)
+    assert aggregation_enabled(True) is True
+    assert aggregation_enabled(False) is False
+    assert aggregation_enabled() == (jax.default_backend() == "tpu")
+    for off in ("0", "false", "off"):
+        monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", off)
+        assert aggregation_enabled() is False
+        assert aggregation_enabled(True) is True   # override wins
+    monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", "1")
+    assert aggregation_enabled() is True
+    assert aggregation_enabled(False) is False
+
+
+# ------------------------------------------------------------- trainers
+
+def _glove_corpus(rng, n=60, length=18, vocab=25):
+    return [["w%d" % w for w in rng.randint(0, vocab, length)]
+            for _ in range(n)]
+
+
+def test_glove_fit_parity_fused_vs_naive():
+    """Full GloVe fits through the fused dual-buffer path and the naive
+    eight-scatter kernel land on the same tables (both paths consume
+    the identical shuffle stream; only scatter form differs)."""
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    rng = np.random.RandomState(7)
+    seqs = _glove_corpus(rng)
+    kw = dict(layer_size=12, window_size=3, epochs=3, batch_size=128,
+              min_word_frequency=1, seed=11)
+    g_f = Glove(**kw)
+    g_f.use_fused_scatter = True
+    g_f.fit(seqs)
+    g_n = Glove(**kw)
+    g_n.use_fused_scatter = False
+    g_n.fit(seqs)
+    np.testing.assert_allclose(
+        np.asarray(g_f.lookup_table.syn0),
+        np.asarray(g_n.lookup_table.syn0), rtol=2e-4, atol=2e-5)
+    assert np.isclose(g_f.last_epoch_loss, g_n.last_epoch_loss,
+                      rtol=1e-4)
+
+
+def test_hs_update_parity_agg_vs_naive(monkeypatch):
+    """The hierarchical-softmax kernel DeepWalk, word2vec, and PV-HS
+    share: aggregated vs naive scatters over a duplicate-heavy Huffman
+    path grid (every pair hits the root).  Eager calls — the gate
+    resolves at trace time, so jitted twins can't be env-flipped."""
+    from deeplearning4j_tpu.nlp.word2vec import _hs_update
+
+    rng = np.random.RandomState(8)
+    B, V, L, D = 256, 40, 5, 12
+    syn0 = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.1)
+    syn1 = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.1)
+    inputs = jnp.asarray(_dup_heavy(rng, B, V))
+    points = rng.randint(0, V, (B, L)).astype(np.int32)
+    points[:, 0] = 0                     # shared root: max duplication
+    codes = jnp.asarray(rng.randint(0, 2, (B, L)).astype(np.float32))
+    cmask = jnp.asarray((rng.rand(B, L) < 0.8).astype(np.float32))
+    pmask = jnp.asarray((rng.rand(B) < 0.9).astype(np.float32))
+    args = (inputs, jnp.asarray(points), codes, cmask, pmask,
+            jnp.float32(0.025))
+
+    monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", "1")
+    s0_a, s1_a, loss_a = _hs_update(syn0, syn1, *args)
+    monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", "0")
+    s0_n, s1_n, loss_n = _hs_update(syn0, syn1, *args)
+    np.testing.assert_allclose(np.asarray(s0_a), np.asarray(s0_n),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1_a), np.asarray(s1_n),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(loss_a), float(loss_n), rtol=1e-6)
+
+
+def test_ns_update_parity_agg_vs_naive(monkeypatch):
+    """The negative-sampling kernel (PV-DBOW / word2vec NS): negative
+    draws repeat hot unigram rows — the other duplicate-heavy regime."""
+    from deeplearning4j_tpu.nlp.word2vec import _ns_update
+
+    rng = np.random.RandomState(9)
+    B, V, K, D = 256, 40, 5, 12
+    syn0 = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.1)
+    syn1neg = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.1)
+    inputs = jnp.asarray(_dup_heavy(rng, B, V))
+    targets = np.concatenate(
+        [rng.randint(0, V, (B, 1)),
+         np.stack([_dup_heavy(rng, B, V) for _ in range(K)], 1)],
+        axis=1).astype(np.int32)
+    labels = jnp.asarray(
+        np.concatenate([[1.0], np.zeros(K)]).astype(np.float32))
+    tmask = jnp.asarray((rng.rand(B, 1 + K) < 0.95).astype(np.float32))
+    pmask = jnp.asarray((rng.rand(B) < 0.9).astype(np.float32))
+    args = (inputs, jnp.asarray(targets), labels, tmask, pmask,
+            jnp.float32(0.025))
+
+    monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", "1")
+    s0_a, s1_a, loss_a = _ns_update(syn0, syn1neg, *args)
+    monkeypatch.setenv("DL4J_TPU_SCATTER_AGG", "0")
+    s0_n, s1_n, loss_n = _ns_update(syn0, syn1neg, *args)
+    np.testing.assert_allclose(np.asarray(s0_a), np.asarray(s0_n),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1_a), np.asarray(s1_n),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(loss_a), float(loss_n), rtol=1e-6)
+
+
+# ------------------------------------------- on-device walk generation
+
+def _two_clique_graph(rng, size=12):
+    from deeplearning4j_tpu.graph.graph import Graph
+
+    g = Graph(2 * size)
+    for c in (0, size):
+        for i in range(size):
+            for j in range(i + 1, size):
+                if rng.rand() < 0.6:
+                    g.add_edge(c + i, c + j)
+    g.add_edge(0, size)
+    return g
+
+
+def test_device_walk_determinism_fixed_fit_rng():
+    """Two fresh fits under the same seed are BIT-identical: walk
+    generation is threefry on device, keyed only by (seed, pass
+    counter) — no host RNG, no iteration-order dependence."""
+    from deeplearning4j_tpu.graph.deepwalk import (DeepWalk,
+                                                   device_walks_enabled)
+
+    if not device_walks_enabled():
+        pytest.skip("device walks disabled via env")
+    g = _two_clique_graph(np.random.RandomState(10))
+
+    def fresh_fit():
+        dw = (DeepWalk.Builder().vector_size(16).window_size(2)
+              .seed(11).build())
+        dw.initialize(g)
+        dw.fit(g, walk_length=10, epochs=2)
+        return np.asarray(dw.syn0), np.asarray(dw.syn1)
+
+    s0_a, s1_a = fresh_fit()
+    s0_b, s1_b = fresh_fit()
+    assert np.array_equal(s0_a, s0_b)
+    assert np.array_equal(s1_a, s1_b)
+
+
+def test_device_walk_scan_dispatch_count():
+    """One watched-jit entry per epoch — the walk epoch runs as a
+    single scan dispatch (generation + pairing + updates fused), not a
+    per-batch loop."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.graph.deepwalk import (DeepWalk,
+                                                   device_walks_enabled)
+
+    if not device_walks_enabled():
+        pytest.skip("device walks disabled via env")
+
+    def calls():
+        return (monitor.counter("jit_compiles_total", "").value(
+                    fn="deepwalk.device_walk_epoch")
+                + monitor.counter("jit_cache_hits_total", "").value(
+                    fn="deepwalk.device_walk_epoch"))
+
+    g = _two_clique_graph(np.random.RandomState(12))
+    dw = (DeepWalk.Builder().vector_size(8).window_size(2).seed(3)
+          .build())
+    dw.initialize(g)
+    before = calls()
+    dw.fit(g, walk_length=8, epochs=3)
+    assert calls() - before == 3

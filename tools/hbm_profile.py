@@ -16,7 +16,8 @@ Reference analogue: the cuDNN tier's workspace/memory accounting
 (``CudnnConvolutionHelper.java:64-140``) — the reference's only
 memory-tuning surface.
 
-Usage: python tools/hbm_profile.py [resnet|lenet|vgg|gather] [top_n]
+Usage: python tools/hbm_profile.py
+           [resnet|lenet|vgg|gather|glove|glove-naive] [top_n]
 
 ``gather`` profiles the epoch-cache v2 program
 (``MultiLayerNetwork._gather_train_step``): on-device threefry epoch
@@ -176,6 +177,32 @@ def compiled_step(config: str):
                 net.iteration, f, l, net._rng_key, shuffle_key, 0, 1,
                 steps, batch, True, 0, (255.0, 1.0, 0.0))
         return net._gather_train_step.lower(*args).compile()
+    elif config in ("glove", "glove-naive"):
+        # scatter-row audit for the embedding economics work: compile a
+        # 1-chunk GloVe epoch twin and count its scatter instructions.
+        # The fused dual-buffer path must show TWO scatters (one per
+        # packed side table, sorted-unique); the naive reference shows
+        # EIGHT (W/b/hW/hb x2 sides), each a colliding duplicate-row
+        # scatter.  Same audit surface as the ResNet conv rows.
+        from deeplearning4j_tpu.nlp.glove import (_glove_epoch,
+                                                  _glove_epoch_fused)
+        V, D, B = 20000, 128, 8192
+        rows = jnp.zeros((B,), jnp.int32)
+        cols = jnp.zeros((B,), jnp.int32)
+        logx = jnp.zeros((B,), jnp.float32)
+        fx = jnp.zeros((B,), jnp.float32)
+        order = jnp.zeros((1, B), jnp.int32)
+        lr = jnp.float32(0.05)
+        if config == "glove":
+            Sr = jnp.zeros((V, 2 * D + 2), jnp.float32)
+            Sc = jnp.zeros((V, 2 * D + 2), jnp.float32)
+            return _glove_epoch_fused.lower(Sr, Sc, rows, cols, logx,
+                                            fx, order, lr).compile()
+        W = jnp.zeros((V, D), jnp.float32)
+        tabs = (W, W + 0, jnp.zeros((V,)), jnp.zeros((V,)), W + 0,
+                W + 0, jnp.zeros((V,)), jnp.zeros((V,)))
+        return _glove_epoch.lower(*tabs, rows, cols, logx, fx,
+                                  order, lr).compile()
     else:
         from deeplearning4j_tpu.models.lenet import lenet
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -229,6 +256,22 @@ def main() -> int:
     print("\n# traffic by op class (all instructions)")
     for cls, b in sorted(by_class.items(), key=lambda kv: -kv[1]):
         print(f"{b/1e6:8.1f} MB  {100*b/total:5.1f}%  {cls}")
+    # the scatter-row audit line the embedding configs exist for: how
+    # many distinct scatter-add sites the step issues (counted from HLO
+    # metadata (op_name, source_line) — robust to CPU lowering scatters
+    # into loop fusions), and whether the program carries the
+    # sorted/unique promises that unlock the non-colliding path
+    sites = set()
+    for m in re.finditer(r"metadata=\{([^}]*)\}", hlo):
+        md = m.group(1)
+        op = re.search(r'op_name="([^" ]*)', md)
+        if op and "scatter-add" in op.group(1):
+            ln = re.search(r"source_line=(\d+)", md)
+            sites.add((op.group(1), ln.group(1) if ln else "?"))
+    if sites:
+        print(f"\n# scatter audit: {len(sites)} scatter-add site(s) per "
+              f"step; {hlo.count('unique_indices=true')} instruction(s) "
+              f"marked unique_indices=true")
     register_monitor_gauges(config, by_class, total)
     return 0
 
