@@ -170,6 +170,16 @@ def _roofline_fields(cost: dict, steps_per_sec: float) -> dict:
     if bts:
         out["hbm_bytes_per_step"] = round(bts, 1)
         out["hbm_gb_per_sec"] = round(bts * steps_per_sec / 1e9, 1)
+    # XLA's own bytes estimate next to whatever model fed "bytes": on
+    # rows where a hand model overrode it (scatter kernels; the compiler
+    # charges full-table traffic), "bytes_xla" preserves the compiler
+    # number so both are printed — and large disagreement is FLAGGED
+    # rather than silently resolved (MLPerf-style cost-model rooflines).
+    xla_bts = cost.get("bytes_xla", bts)
+    if xla_bts:
+        out["bytes_model_xla"] = round(xla_bts, 1)
+        if bts and abs(bts - xla_bts) / max(bts, xla_bts) > 0.25:
+            out["hbm_model_mismatch"] = True
     peaks = _chip_peaks()
     if peaks is not None:
         peak_flops, peak_bw = peaks
@@ -511,6 +521,7 @@ def bench_word2vec(vocab: int = 10000, dim: int = 128, batch: int = 8192,
     # locality, so this is the achievable-traffic model, not a lower
     # bound artifact.
     cost = _compiled_cost(multi.lower(syn0, syn1, 1).compile())
+    cost["bytes_xla"] = cost.get("bytes")
     K = negative
     hand_bytes = (2 * batch * dim * 4            # syn0 gather + scatter
                   + 2 * batch * (1 + K) * dim * 4  # syn1neg gather+scatter
@@ -641,6 +652,7 @@ def bench_glove(vocab: int = 20000, dim: int = 128, batch: int = 8192,
     # int32/f32 triple operands.
     cost = _compiled_cost(_glove_epoch_fused.lower(
         Sr, Sc, rows_d, cols_d, logx, fx, order_d[:1], lr).compile())
+    cost["bytes_xla"] = cost.get("bytes")
     hand_bytes = (2 * 2 * batch * (2 * dim + 2) * 4    # gather+scatter x2 sides
                   + batch * (4 + 4 + 4 + 4))           # rows/cols/logx/fx
     cost["bytes"] = float(hand_bytes)
@@ -742,6 +754,15 @@ def bench_deepwalk(n_vertices: int = 20000, n_edges: int = 200_000,
                   hand_bytes * epochs_per_window / meas["median"] / 1e9,
                   1),
               "avg_code_len": round(avg_len, 2)}
+    # The walk-epoch executable published its compiler cost estimate on
+    # first compile (monitor.jit_watch); print it next to the hand model
+    # and flag >25% disagreement like every other roofline row.
+    xla_bytes = monitor.gauge("xla_cost_bytes_accessed", "").value(
+        fn="deepwalk.device_walk_epoch")
+    if xla_bytes:
+        result["bytes_model_xla"] = round(xla_bytes, 1)
+        if abs(hand_bytes - xla_bytes) / max(hand_bytes, xla_bytes) > 0.25:
+            result["hbm_model_mismatch"] = True
     result.update(_band_fields(meas, work, trials))
     return result
 

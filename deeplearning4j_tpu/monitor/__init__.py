@@ -33,14 +33,20 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-from .jit_watch import WatchedJit, watched_jit
+from . import health
+from .health import (TrainingDivergedError, disable as disable_health,
+                     enable as enable_health, enabled as health_enabled,
+                     snapshot as health_snapshot)
+from .jit_watch import WatchedJit, publish_cost_analysis, watched_jit
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, registry)
 from .tracing import Tracer, span, tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
-    "WatchedJit", "counter", "gauge", "histogram", "observe_phase",
-    "phase_breakdown", "post_system_metrics", "prometheus_text",
+    "TrainingDivergedError", "WatchedJit", "counter", "disable_health",
+    "enable_health", "gauge", "health", "health_enabled",
+    "health_snapshot", "histogram", "observe_phase", "phase_breakdown",
+    "post_system_metrics", "prometheus_text", "publish_cost_analysis",
     "registry", "reset", "snapshot", "span", "system_metrics_persistable",
     "trace_jsonl", "tracer", "watched_jit",
 ]
@@ -166,8 +172,10 @@ def post_system_metrics(router, model, session_id: str,
 
 
 def reset() -> None:
-    """Clear every metric and trace event (test / bench isolation).
+    """Clear every metric and trace event (test / bench isolation), and
+    return the health layer to its env-configured default state.
     Live instrumentation keeps working: all call sites re-resolve their
     metric objects through the registry on each update."""
     registry().clear()
     tracer().clear()
+    health.reset()

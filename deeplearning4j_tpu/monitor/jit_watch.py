@@ -40,6 +40,9 @@ from .tracing import tracer
 COMPILES_TOTAL = "jit_compiles_total"
 CACHE_HITS_TOTAL = "jit_cache_hits_total"
 COMPILE_MS = "jit_compile_ms"
+XLA_FLOPS = "xla_cost_flops"
+XLA_BYTES = "xla_cost_bytes_accessed"
+XLA_PEAK_HBM = "xla_cost_peak_hbm_bytes"
 
 _HELP = {
     COMPILES_TOTAL: "jitted-function compilations (first call per "
@@ -47,7 +50,58 @@ _HELP = {
     CACHE_HITS_TOTAL: "jitted-function calls served from the trace cache",
     COMPILE_MS: "wall time of each compiling call (trace + compile + "
                 "first dispatch, ms)",
+    XLA_FLOPS: "XLA cost_analysis flop estimate of the executable's "
+               "most recent compile",
+    XLA_BYTES: "XLA cost_analysis bytes-accessed estimate of the "
+               "executable's most recent compile",
+    XLA_PEAK_HBM: "compiler memory_analysis peak HBM (args + outputs + "
+                  "temps - aliased) of the most recent AOT compile",
 }
+
+
+def publish_cost_analysis(name: str, obj: Any) -> None:
+    """Publish compiler self-reported cost gauges for an executable.
+
+    ``obj`` is anything with a ``cost_analysis()`` (a ``Lowered`` on the
+    implicit-jit path, a ``Compiled`` on the AOT path) and optionally a
+    ``memory_analysis()`` (Compiled only).  Publishes
+    ``xla_cost_flops{fn=name}`` and ``xla_cost_bytes_accessed{fn=name}``
+    from cost_analysis and ``xla_cost_peak_hbm_bytes{fn=name}`` from
+    memory_analysis (argument + output + temp - aliased bytes).  Every
+    probe is best-effort: backends that do not implement an analysis are
+    silently skipped.
+    """
+    reg = registry()
+    try:
+        cost = obj.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost:
+            flops = cost.get("flops")
+            if flops is not None:
+                reg.gauge(XLA_FLOPS, _HELP[XLA_FLOPS]).set(
+                    float(flops), fn=name)
+            nbytes = cost.get("bytes accessed",
+                              cost.get("bytes_accessed"))
+            if nbytes is not None:
+                reg.gauge(XLA_BYTES, _HELP[XLA_BYTES]).set(
+                    float(nbytes), fn=name)
+    except Exception:
+        pass
+    try:
+        mem = obj.memory_analysis()
+        if isinstance(mem, (list, tuple)):
+            mem = mem[0] if mem else None
+        if mem is not None:
+            peak = (float(getattr(mem, "argument_size_in_bytes", 0.0))
+                    + float(getattr(mem, "output_size_in_bytes", 0.0))
+                    + float(getattr(mem, "temp_size_in_bytes", 0.0))
+                    - float(getattr(mem, "alias_size_in_bytes", 0.0)))
+            if peak > 0:
+                reg.gauge(XLA_PEAK_HBM, _HELP[XLA_PEAK_HBM]).set(
+                    peak, fn=name)
+    except Exception:
+        pass
 
 
 def _leaf_desc(leaf: Any) -> str:
@@ -107,6 +161,7 @@ class _LoweredProxy:
             fn=self._name)
         reg.histogram(COMPILE_MS, _HELP[COMPILE_MS]).observe(
             elapsed * 1e3, fn=self._name)
+        publish_cost_analysis(self._name, compiled)
         return compiled
 
     def __getattr__(self, item):
@@ -141,6 +196,15 @@ class WatchedJit:
             return self._jitted(*args, **kwargs)
         recompile = bool(self._seen)
         self._seen.add(signature)
+        if not recompile:
+            # Cost gauges for the first signature only: .lower() traces
+            # without compiling or consuming donated buffers, and one
+            # extra trace per WatchedJit bounds the overhead.
+            try:
+                publish_cost_analysis(
+                    self.name, self._jitted.lower(*args, **kwargs))
+            except Exception:
+                pass
         t0 = time.perf_counter()
         with tracer().span(f"jit/compile/{self.name}",
                            signature=signature, recompile=recompile):

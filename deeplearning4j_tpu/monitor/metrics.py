@@ -17,6 +17,7 @@ Two read paths:
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -222,6 +223,12 @@ class Histogram(_Metric):
 
 
 def _fmt(v: float) -> str:
+    # NaN/Inf gauges are legal (a diverged loss IS NaN); Prometheus text
+    # spec spells them NaN / +Inf / -Inf
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(float(v))

@@ -267,8 +267,13 @@ class ComputationGraph:
                 new_ustate[name] = updater_state[name]
         return new_params, new_ustate
 
-    @functools.cached_property
-    def _train_step(self):
+    def _build_train_step(self, health: bool):
+        """Graph train step builder; ``health=True`` adds the packed
+        per-layer stats vector + in-jit divergence guard
+        (``monitor/health.py``), with per-vertex stats keyed in
+        ``_layer_names()`` topo order."""
+        from ..monitor import health as _health
+
         def step(params, updater_state, net_state, iteration, features,
                  labels, features_masks, labels_masks, base_rng):
             rng = jax.random.fold_in(base_rng, iteration)
@@ -279,21 +284,41 @@ class ComputationGraph:
             new_params, new_ustate = self._apply_updates(
                 params, updater_state, grads, iteration)
             score = data_loss + self._reg_score(params)
-            return new_params, new_ustate, new_state, score
+            if not health:
+                return new_params, new_ustate, new_state, score
+            hvec, bad = _health.layer_stats(params, new_params, grads,
+                                            data_loss,
+                                            order=self._layer_names())
+            new_params, new_ustate, new_state = _health.guard_select(
+                bad, (new_params, new_ustate, new_state),
+                (params, updater_state, net_state))
+            return new_params, new_ustate, new_state, score, hvec
 
         return _monitor.watched_jit(step, name="cg.train_step",
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _multi_train_step(self):
+    def _train_step(self):
+        """Plain 4-output graph step (external callers)."""
+        return self._build_train_step(health=False)
+
+    @functools.cached_property
+    def _train_step_h(self):
+        """Health-instrumented graph step; the ``fit`` paths use this."""
+        return self._build_train_step(health=True)
+
+    def _build_multi_train_step(self, health: bool):
         """S sequential graph train steps in ONE XLA program via
         ``lax.scan`` over per-input stacked (S, B, ...) batches — the graph
         twin of ``MultiLayerNetwork._multi_train_step``.  One dispatch runs
         the whole loop on-chip, so throughput is set by the MXU rather
         than by host→device dispatch latency (the reference's inner loop
-        is host-driven, ``StochasticGradientDescent.java:50-72``)."""
+        is host-driven, ``StochasticGradientDescent.java:50-72``).
+        ``health=True`` stacks the packed per-step stats vector as a
+        second scan output riding the same dispatch."""
 
         from . import ingest
+        from ..monitor import health as _health
 
         def multi(params, updater_state, net_state, iteration, features,
                   labels, features_masks, labels_masks, base_rng,
@@ -310,20 +335,39 @@ class ComputationGraph:
                         p, s, f, l, fm, lm, rng, True)
                 new_p, new_u = self._apply_updates(p, u, grads, it)
                 score = data_loss + self._reg_score(p)
-                return (new_p, new_u, new_s, it + 1), score
+                if not health:
+                    return (new_p, new_u, new_s, it + 1), score
+                hvec, bad = _health.layer_stats(
+                    p, new_p, grads, data_loss,
+                    order=self._layer_names())
+                new_p, new_u, new_s = _health.guard_select(
+                    bad, (new_p, new_u, new_s), (p, u, s))
+                return (new_p, new_u, new_s, it + 1), (score, hvec)
 
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
-            (params, updater_state, net_state, _), scores = jax.lax.scan(
+            (params, updater_state, net_state, _), out = jax.lax.scan(
                 body, init,
                 (features, labels, features_masks, labels_masks))
-            return params, updater_state, net_state, scores
+            if not health:
+                return params, updater_state, net_state, out
+            scores, hstack = out
+            return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="cg.multi_train_step",
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _gather_train_step(self):
+    def _multi_train_step(self):
+        """Plain 4-output graph scan step (AOT benches, profilers)."""
+        return self._build_multi_train_step(health=False)
+
+    @functools.cached_property
+    def _multi_train_step_h(self):
+        """Health-instrumented graph scan step; ``fit`` paths use this."""
+        return self._build_multi_train_step(health=True)
+
+    def _build_gather_train_step(self, health: bool):
         """Device-cached-epoch graph train step, v2 (see
         ``MultiLayerNetwork._gather_train_step``): the epoch permutation
         is derived ON DEVICE from ``fold_in(shuffle_key, epoch)`` and up
@@ -331,8 +375,11 @@ class ComputationGraph:
         its minibatch from HBM-resident per-input dataset arrays —
         steady-state epochs move zero bytes host->device.  ``wires`` is
         the per-input ``(denom, mult, add)``/None tuple fusing the uint8
-        wire decode into the gathered batch."""
+        wire decode into the gathered batch.  ``health=True`` adds the
+        per-step stats stack as a second scan output, keeping the fused
+        multi-epoch program at ONE dispatch per call."""
         from . import ingest
+        from ..monitor import health as _health
 
         def multi(params, updater_state, net_state, iteration, data_fs,
                   data_ls, base_rng, shuffle_key, first_epoch, fused,
@@ -363,17 +410,38 @@ class ComputationGraph:
                         p, s, f, l, None, None, rng, True)
                 new_p, new_u = self._apply_updates(p, u, grads, it)
                 score = data_loss + self._reg_score(p)
-                return (new_p, new_u, new_s, it + 1), score
+                if not health:
+                    return (new_p, new_u, new_s, it + 1), score
+                hvec, bad = _health.layer_stats(
+                    p, new_p, grads, data_loss,
+                    order=self._layer_names())
+                new_p, new_u, new_s = _health.guard_select(
+                    bad, (new_p, new_u, new_s), (p, u, s))
+                return (new_p, new_u, new_s, it + 1), (score, hvec)
 
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
-            (params, updater_state, net_state, _), scores = jax.lax.scan(
+            (params, updater_state, net_state, _), out = jax.lax.scan(
                 body, init, rows)
-            return params, updater_state, net_state, scores
+            if not health:
+                return params, updater_state, net_state, out
+            scores, hstack = out
+            return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="cg.gather_train_step",
                                     static_argnums=(9, 10, 11, 12, 13),
                                     donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _gather_train_step(self):
+        """Plain 4-output gather step (profilers, external callers)."""
+        return self._build_gather_train_step(health=False)
+
+    @functools.cached_property
+    def _gather_train_step_h(self):
+        """Health-instrumented gather step; ``_fit_device_cached`` uses
+        this one."""
+        return self._build_gather_train_step(health=True)
 
     def _fit_device_cached(self, source, epochs: int):
         """Graph twin of ``MultiLayerNetwork._fit_device_cached``:
@@ -392,11 +460,12 @@ class ComputationGraph:
 
         def dispatch(first_epoch, fused, tail):
             (self.params, self.updater_state, self.net_state,
-             scores) = self._gather_train_step(
+             scores, health) = self._gather_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, data_fs, data_ls, self._rng_key,
                 shuffle_key, first_epoch, fused, steps, source._batch,
                 bool(source._shuffle), tail, (wire,))
+            _monitor.health.record_dispatch(self, health, self.iteration)
             return scores
 
         return ingest.run_device_cached_fit(self, source, epochs, dispatch)
@@ -432,10 +501,11 @@ class ComputationGraph:
             t1 = time.perf_counter()
             _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
-             scores) = self._multi_train_step(
+             scores, health) = self._multi_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fms, lms, self._rng_key,
                 wires)
+            _monitor.health.record_dispatch(self, health, self.iteration)
             replay.add(self.iteration, scores)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             _monitor.counter("train_iterations_total",
@@ -525,9 +595,10 @@ class ComputationGraph:
         lmasks = stack_masks(lambda m: m.labels_masks, n_out)
         t1 = time.perf_counter()
         (self.params, self.updater_state, self.net_state,
-         scores) = self._multi_train_step(
+         scores, health) = self._multi_train_step_h(
             self.params, self.updater_state, self.net_state, self.iteration,
             features, labels, fmasks, lmasks, self._rng_key)
+        _monitor.health.record_dispatch(self, health, self.iteration)
         _monitor.observe_phase("step", time.perf_counter() - t1)
         _monitor.counter("train_iterations_total",
                          "supervised train iterations").inc(len(mbs))
@@ -862,10 +933,11 @@ class ComputationGraph:
         for _ in range(self.conf.conf.num_iterations):
             t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state,
-             score) = self._train_step(
+             score, health) = self._train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fmasks, lmasks,
                 self._rng_key)
+            _monitor.health.record_dispatch(self, health, self.iteration)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             self._score = score
             self.iteration += 1

@@ -229,11 +229,19 @@ class MultiLayerNetwork:
                 new_updater_state.append(updater_state[i])
         return new_params, new_updater_state
 
-    @functools.cached_property
-    def _train_step(self):
+    def _build_train_step(self, health: bool):
         """Build the jitted train step: fwd + bwd + updater in one XLA
         program.  Donation lets XLA update params/updater state in place in
-        HBM (the analogue of the reference's in-place flat-buffer step)."""
+        HBM (the analogue of the reference's in-place flat-buffer step).
+
+        With ``health=True`` the step additionally packs the per-layer
+        grad/param/update statistics (``monitor/health.py``) — a few
+        scalar reductions over values already in registers — applies the
+        in-jit divergence guard, and returns the packed vector as a
+        fifth output.  Both variants register under the same compile-
+        watch name: the fit paths dispatch the health variant only, so
+        the per-``fn`` compile counters stay meaningful."""
+        from ..monitor import health as _health
 
         def step(params, updater_state, net_state, iteration, features,
                  labels, features_mask, labels_mask, base_rng):
@@ -245,20 +253,41 @@ class MultiLayerNetwork:
             new_params, new_updater_state = self._apply_updates(
                 params, updater_state, grads, iteration)
             score = data_loss + self._reg_score(params)
-            return new_params, new_updater_state, new_state, score
+            if not health:
+                return new_params, new_updater_state, new_state, score
+            hvec, bad = _health.layer_stats(params, new_params, grads,
+                                            data_loss)
+            new_params, new_updater_state, new_state = _health.guard_select(
+                bad, (new_params, new_updater_state, new_state),
+                (params, updater_state, net_state))
+            return new_params, new_updater_state, new_state, score, hvec
 
         return _monitor.watched_jit(step, name="mln.train_step",
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _multi_train_step(self):
+    def _train_step(self):
+        """Plain 4-output step (external callers: benches, scaling)."""
+        return self._build_train_step(health=False)
+
+    @functools.cached_property
+    def _train_step_h(self):
+        """Health-instrumented step; the ``fit`` paths use this one."""
+        return self._build_train_step(health=True)
+
+    def _build_multi_train_step(self, health: bool):
         """S sequential train steps in ONE XLA program via ``lax.scan`` over
         stacked (S, B, ...) batches.  The reference runs its inner loop on
         the host (``StochasticGradientDescent.java:50-72``, one dispatch per
         iteration); on TPU the scan keeps the whole loop on-chip, so
-        throughput is set by the MXU, not by host dispatch latency."""
+        throughput is set by the MXU, not by host dispatch latency.
+
+        ``health=True`` stacks the packed per-step health vector as a
+        second scan output — (S, 2+3L) f32 riding the same dispatch, so
+        exact per-step telemetry costs zero extra dispatches."""
 
         from . import ingest
+        from ..monitor import health as _health
 
         def multi(params, updater_state, net_state, iteration, features,
                   labels, features_mask, labels_mask, base_rng, wire=None):
@@ -272,19 +301,36 @@ class MultiLayerNetwork:
                         p, s, f, l, fm, lm, rng, True)
                 new_p, new_u = self._apply_updates(p, u, grads, it)
                 score = data_loss + self._reg_score(p)
-                return (new_p, new_u, new_s, it + 1), score
+                if not health:
+                    return (new_p, new_u, new_s, it + 1), score
+                hvec, bad = _health.layer_stats(p, new_p, grads, data_loss)
+                new_p, new_u, new_s = _health.guard_select(
+                    bad, (new_p, new_u, new_s), (p, u, s))
+                return (new_p, new_u, new_s, it + 1), (score, hvec)
 
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
-            (params, updater_state, net_state, _), scores = jax.lax.scan(
+            (params, updater_state, net_state, _), out = jax.lax.scan(
                 body, init, (features, labels, features_mask, labels_mask))
-            return params, updater_state, net_state, scores
+            if not health:
+                return params, updater_state, net_state, out
+            scores, hstack = out
+            return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="mln.multi_train_step",
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
-    def _gather_train_step(self):
+    def _multi_train_step(self):
+        """Plain 4-output scan step (AOT benches, profilers)."""
+        return self._build_multi_train_step(health=False)
+
+    @functools.cached_property
+    def _multi_train_step_h(self):
+        """Health-instrumented scan step; the ``fit`` paths use this."""
+        return self._build_multi_train_step(health=True)
+
+    def _build_gather_train_step(self, health: bool):
         """Device-cached-epoch train step, v2: the epoch PERMUTATION is
         computed on device (threefry ``fold_in(shuffle_key, epoch)``
         feeding ``jax.random.permutation``) and up to ``fused`` whole
@@ -301,8 +347,13 @@ class MultiLayerNetwork:
         (weak int32) so advancing epochs never retraces.  ``tail > 0``
         selects the 1-step tail dispatch: the SAME epoch permutation is
         recomputed and its last ``tail`` entries form the ragged final
-        batch, keeping v1's batch boundaries."""
+        batch, keeping v1's batch boundaries.
+
+        ``health=True`` adds the (S, 2+3L) packed per-step health stack
+        as a second scan output, fetched once per dispatch — the fused
+        multi-epoch program stays ONE dispatch per call."""
         from . import ingest
+        from ..monitor import health as _health
 
         def multi(params, updater_state, net_state, iteration, data_f,
                   data_l, base_rng, shuffle_key, first_epoch, fused,
@@ -333,17 +384,36 @@ class MultiLayerNetwork:
                         p, s, f, l, None, None, rng, True)
                 new_p, new_u = self._apply_updates(p, u, grads, it)
                 score = data_loss + self._reg_score(p)
-                return (new_p, new_u, new_s, it + 1), score
+                if not health:
+                    return (new_p, new_u, new_s, it + 1), score
+                hvec, bad = _health.layer_stats(p, new_p, grads, data_loss)
+                new_p, new_u, new_s = _health.guard_select(
+                    bad, (new_p, new_u, new_s), (p, u, s))
+                return (new_p, new_u, new_s, it + 1), (score, hvec)
 
             init = (params, updater_state, net_state,
                     jnp.asarray(iteration, jnp.int32))
-            (params, updater_state, net_state, _), scores = jax.lax.scan(
+            (params, updater_state, net_state, _), out = jax.lax.scan(
                 body, init, rows)
-            return params, updater_state, net_state, scores
+            if not health:
+                return params, updater_state, net_state, out
+            scores, hstack = out
+            return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="mln.gather_train_step",
                                     static_argnums=(9, 10, 11, 12, 13),
                                     donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _gather_train_step(self):
+        """Plain 4-output gather step (profilers, external callers)."""
+        return self._build_gather_train_step(health=False)
+
+    @functools.cached_property
+    def _gather_train_step_h(self):
+        """Health-instrumented gather step; ``_fit_device_cached`` uses
+        this one."""
+        return self._build_gather_train_step(health=True)
 
     def _fit_device_cached(self, source, epochs: int):
         """One ``fit`` over a device-resident dataset (see
@@ -365,11 +435,12 @@ class MultiLayerNetwork:
 
         def dispatch(first_epoch, fused, tail):
             (self.params, self.updater_state, self.net_state,
-             scores) = self._gather_train_step(
+             scores, health) = self._gather_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, data_f, data_l, self._rng_key,
                 shuffle_key, first_epoch, fused, steps, source._batch,
                 bool(source._shuffle), tail, wire)
+            _monitor.health.record_dispatch(self, health, self.iteration)
             return scores
 
         return ingest.run_device_cached_fit(self, source, epochs, dispatch)
@@ -405,10 +476,11 @@ class MultiLayerNetwork:
             t1 = time.perf_counter()
             _monitor.observe_phase("data", t1 - t0)
             (self.params, self.updater_state, self.net_state,
-             scores) = self._multi_train_step(
+             scores, health) = self._multi_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fm, lm, self._rng_key,
                 wire)
+            _monitor.health.record_dispatch(self, health, self.iteration)
             replay.add(self.iteration, scores)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             _monitor.counter("train_iterations_total",
@@ -486,9 +558,10 @@ class MultiLayerNetwork:
         t1 = time.perf_counter()
         _monitor.observe_phase("data", t1 - t0)
         (self.params, self.updater_state, self.net_state,
-         scores) = self._multi_train_step(
+         scores, health) = self._multi_train_step_h(
             self.params, self.updater_state, self.net_state, self.iteration,
             features, labels, fmask, lmask, self._rng_key)
+        _monitor.health.record_dispatch(self, health, self.iteration)
         _monitor.observe_phase("step", time.perf_counter() - t1)
         _monitor.counter("train_iterations_total",
                          "supervised train iterations").inc(len(batches))
@@ -855,10 +928,11 @@ class MultiLayerNetwork:
         for _ in range(self.conf.conf.num_iterations):
             t1 = time.perf_counter()
             (self.params, self.updater_state, self.net_state,
-             score) = self._train_step(
+             score, health) = self._train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, features, labels, fmask, lmask,
                 self._rng_key)
+            _monitor.health.record_dispatch(self, health, self.iteration)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             self._score = score
             self.iteration += 1

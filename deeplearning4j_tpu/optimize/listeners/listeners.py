@@ -123,10 +123,19 @@ class ParamAndGradientIterationListener(IterationListener):
 
     Gradients are fused inside the jitted train step and never
     materialise host-side, so the reference's gradient columns are
-    reported as *update* statistics — the parameter delta since this
-    listener last ran, which is what the updater applied (the same
+    reported as *update_win* statistics — the parameter delta since this
+    listener last ran (a WINDOWED delta, which the column names now say
+    explicitly), which is what the updater applied (the same
     substitution the stats listener makes; update:param magnitude ratios
     are the quantity the reference UI derives from these columns anyway).
+
+    When the device-side health layer is enabled
+    (``monitor.enable_health()``) two exact per-step columns are
+    appended from the packed in-jit stats of the model's last dispatch:
+    ``grad_l2_step`` (per-layer gradient L2 norm) and
+    ``update_ratio_step`` (per-layer update:param L2 ratio).  Every
+    param row of a layer carries its layer's value; blank when the layer
+    is not represented in the last health snapshot.
     """
 
     def __init__(self, iterations: int = 1, print_header: bool = True,
@@ -155,7 +164,23 @@ class ParamAndGradientIterationListener(IterationListener):
             return model.param_table()
         return {}
 
-    def _stats(self, name, arr, prev):
+    @staticmethod
+    def _device_stats(model, name):
+        """(grad_l2, update_ratio) for this param's layer from the last
+        health dispatch, or None when the health layer has nothing."""
+        from ...monitor import health as _health
+        if not _health.enabled():
+            return None
+        snap = _health.last_for(model)
+        if snap is None:
+            return None
+        layer = name.rsplit("_", 1)[0]
+        stats = snap["layers"].get(layer)
+        if stats is None:
+            return ("", "")
+        return (f"{stats['grad_l2']:.6g}", f"{stats['update_ratio']:.6g}")
+
+    def _stats(self, name, arr, prev, device=None):
         cols = [name]
         if self.print_mean:
             cols.append(f"{float(np.mean(arr)):.6g}")
@@ -172,17 +197,21 @@ class ParamAndGradientIterationListener(IterationListener):
                      f"{float(np.max(upd)):.6g}"]
         if self.print_mean_abs:
             cols.append(f"{float(np.mean(np.abs(upd))):.6g}")
+        if device is not None:
+            cols += list(device)
         return cols
 
-    def _header(self):
+    def _header(self, with_device=False):
         cols = ["param"]
-        for kind in ("param", "update"):
+        for kind in ("param", "update_win"):
             if self.print_mean:
                 cols.append(f"{kind}_mean")
             if self.print_min_max:
                 cols += [f"{kind}_min", f"{kind}_max"]
             if self.print_mean_abs:
                 cols.append(f"{kind}_mean_abs")
+        if with_device:
+            cols += ["grad_l2_step", "update_ratio_step"]
         return cols
 
     def _emit(self, line: str) -> None:
@@ -196,13 +225,17 @@ class ParamAndGradientIterationListener(IterationListener):
         if iteration % self.iterations != 0:
             return
         tables = self._tables(model)
+        from ...monitor import health as _health
+        with_device = (_health.enabled()
+                       and _health.last_for(model) is not None)
         if self.print_header and not self._header_written:
             self._emit(self.delimiter.join(
-                ["iteration"] + self._header()))
+                ["iteration"] + self._header(with_device)))
             self._header_written = True
         prev = self._last_params or {}
         for name, arr in tables.items():
-            cols = self._stats(name, arr, prev.get(name))
+            device = self._device_stats(model, name) if with_device else None
+            cols = self._stats(name, arr, prev.get(name), device)
             self._emit(self.delimiter.join([str(iteration)] + cols))
         self._last_params = tables
 

@@ -120,6 +120,8 @@ class ParallelWrapper:
         # equality guarantee on recurrent nets.
         tbptt = (not self._is_graph
                  and net.conf.backprop_type == "tbptt")
+        from ..monitor import health as _health
+        horder = list(net._layer_names()) if self._is_graph else None
 
         def local_round(params, updater_state, net_state, iteration,
                         features, labels, fmask, lmask, base_rng, wire):
@@ -167,6 +169,8 @@ class ParallelWrapper:
                     T = f.shape[1]
                     carries = net._init_carries(f.shape[0])
                     score = jnp.float32(0.0)
+                    params0, ustate0, state0 = (params, updater_state,
+                                                net_state)
                     for start in range(0, T, window):
                         stop = min(start + window, T)
                         adv = max(0, (stop - start) - back)
@@ -183,7 +187,17 @@ class ParallelWrapper:
                             params, updater_state, grads, it)
                         score = data_loss + net._reg_score(params)
                         it = it + 1
-                    return (params, updater_state, net_state, it), score
+                    # tBPTT health is coarse: one vector for the whole
+                    # batch (pre-loop params vs post-loop params, last
+                    # window's grads/loss), guarded at batch granularity.
+                    hvec, bad = _health.layer_stats(
+                        params0, params, grads, data_loss, order=horder)
+                    params, updater_state, net_state = \
+                        _health.guard_select(
+                            bad, (params, updater_state, net_state),
+                            (params0, ustate0, state0))
+                    return ((params, updater_state, net_state, it),
+                            (score, hvec))
                 rng = jax.random.fold_in(
                     jax.random.fold_in(base_rng, it), widx)
                 (data_loss, aux), grads = jax.value_and_grad(
@@ -194,9 +208,16 @@ class ParallelWrapper:
                 new_params, new_ustate = net._apply_updates(
                     params, updater_state, grads, it)
                 score = data_loss + net._reg_score(params)
-                return (new_params, new_ustate, new_state, it + 1), score
+                hvec, bad = _health.layer_stats(
+                    params, new_params, grads, data_loss, order=horder)
+                new_params, new_ustate, new_state = _health.guard_select(
+                    bad, (new_params, new_ustate, new_state),
+                    (params, updater_state, net_state))
+                return ((new_params, new_ustate, new_state, it + 1),
+                        (score, hvec))
 
-            (params, updater_state, net_state, _), scores = lax.scan(
+            ((params, updater_state, net_state, _),
+             (scores, hstack)) = lax.scan(
                 one_step, (params, updater_state, net_state, iteration),
                 (features, labels, fmask, lmask))
             # averageAndPropagate: params always, updater state if enabled
@@ -207,15 +228,19 @@ class ParallelWrapper:
                                           to="varying")
             net_state = lax.pmean(net_state, "data")
             score = lax.pmean(jnp.mean(scores), "data")
+            # Mean across workers: a single worker's NaN poisons the
+            # averaged vector and the 0/1 flag column stays > 0 iff any
+            # worker flagged — the pmean'd stack still decodes.
+            health = lax.pmean(hstack, "data")
             # updater state stays per-worker (stacked) across rounds
             updater_state = jax.tree.map(lambda a: a[None], updater_state)
-            return params, updater_state, net_state, score
+            return params, updater_state, net_state, score, health
 
         mesh = self.mesh
         in_specs = (P(), P("data"), P(), P(), P(None, "data"),
                     P(None, "data"), P(None, "data"), P(None, "data"), P(),
                     P())
-        out_specs = (P(), P("data"), P(), P())
+        out_specs = (P(), P("data"), P(), P(), P())
         fn = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
         return _monitor.watched_jit(fn, name="parallel.step",
@@ -433,9 +458,10 @@ class ParallelWrapper:
                 NamedSharding(self.mesh, P("data")))
         t1 = time.perf_counter()
         (net.params, self._worker_ustate, net.net_state,
-         score) = self._parallel_step(
+         score, health) = self._parallel_step(
             net.params, self._worker_ustate, net.net_state,
             net.iteration, feats, labs, fmask, lmask, net._rng_key, wire)
+        _monitor.health.record_dispatch(net, health, net.iteration)
         _monitor.observe_phase("step", time.perf_counter() - t1)
         _monitor.counter("parallel_rounds_total",
                          "parameter-averaging rounds (one pmean sync "

@@ -23,7 +23,11 @@ Runtime-telemetry export (the ``monitor`` package's process globals):
     GET  /metrics  -> Prometheus text exposition (counters/gauges/summaries)
     GET  /trace    -> Chrome trace events, one JSON object per line (wrap
                       the lines in [...] for Perfetto / chrome://tracing)
-    GET  /healthz  -> liveness probe for scrapers
+    GET  /healthz  -> liveness probe for scrapers, enriched with backend
+                      platform, device count, last dispatch time, and
+                      the ok/diverged training-health state
+    GET  /health   -> full training-health snapshot (guard config +
+                      last-dispatch per-layer grad/param/update stats)
 
 Model serving (the ``serving`` package's dynamic-batching engine):
 
@@ -323,7 +327,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _monitor.trace_jsonl().encode(),
                        "application/x-ndjson")
         elif path == "/healthz":
-            self._json({"status": "ok"})
+            self._json(ui.healthz_data())
+        elif path == "/health":
+            self._json(ui.health_data())
         else:
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
@@ -439,6 +445,36 @@ class UIServer:
         if self._engines:
             return next(iter(self._engines.values()))
         return None
+
+    # ---- health endpoints ------------------------------------------------
+    def healthz_data(self) -> dict:
+        """``GET /healthz`` body: still a liveness probe (200 whenever
+        the server answers), enriched with the runtime identity scrapers
+        want on the same poll — backend platform, device count, last
+        train-dispatch timestamp, and the divergence state."""
+        from .. import monitor as _mon
+        backend = device_count = None
+        try:
+            import jax
+            backend = jax.default_backend()
+            device_count = jax.device_count()
+        except Exception:
+            pass
+        return {
+            "status": "ok",
+            "backend": backend,
+            "device_count": device_count,
+            "last_dispatch_timestamp":
+                _mon.health.last_dispatch_timestamp(),
+            "health": _mon.health.state(),
+        }
+
+    def health_data(self) -> dict:
+        """``GET /health`` body: the full training-health snapshot —
+        guard config, ok/diverged state, and the last dispatch's
+        per-layer grad/param/update statistics."""
+        from .. import monitor as _mon
+        return _mon.health.snapshot()
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> "UIServer":

@@ -13,11 +13,14 @@ memory+GC at ``BaseStatsListener.java:320-366``) into a
 
 Sampling runs on the host AFTER the jitted step returns, so the train step
 stays one XLA program (SURVEY.md §7 hard part f); the device fetch of the
-param trees happens only on report iterations.  Update magnitudes are
-measured as the param delta accumulated since the previous report — the
-updater runs inside the fused step, so the per-step update is not observable
-without breaking the single-HLO invariant; the windowed delta carries the
-same signal (ratio of update to param scale).
+param trees happens only on report iterations.  Update magnitudes default
+to the param delta accumulated since the previous report (a windowed
+delta, labelled as such in the report).  When the device-side health
+layer is enabled (``monitor.enable_health()``, docs/OBSERVABILITY.md
+"Training health") the step itself packs exact per-step per-layer
+grad/update statistics into the scan output, and this listener switches
+the update:param ratios to those device values —
+``report["update_stats_source"]`` says which source produced them.
 """
 
 from __future__ import annotations
@@ -161,7 +164,32 @@ class StatsListener(TrainingListener):
                     "counts": counts.tolist(),
                 }
         report["param_mean_magnitudes"] = mean_mags
-        if update_mags:
+        report["update_stats_source"] = "windowed_delta"
+        from ..monitor import health as _health
+        hsnap = _health.last_for(model) if _health.enabled() else None
+        if hsnap is not None:
+            # Exact per-step device stats from the packed scan output:
+            # per-layer update:param L2 ratios replace the windowed
+            # approximation (params are keyed "<layer>_<param>"; every
+            # param of a layer shares its layer's device ratio).
+            dev_ratios = {
+                name: hsnap["layers"][layer]["update_ratio"]
+                for name in params
+                for layer in [name.rsplit("_", 1)[0]]
+                if layer in hsnap["layers"]}
+            if dev_ratios:
+                report["update_stats_source"] = "device_per_step"
+                report["health"] = {
+                    "state": _health.state(),
+                    "loss": hsnap["loss"],
+                    "flagged_steps": hsnap["flagged_steps"],
+                    "layers": hsnap["layers"],
+                }
+                ratios = dev_ratios
+                if update_mags:
+                    report["update_mean_magnitudes"] = update_mags
+                report["update_param_ratios"] = ratios
+        if report["update_stats_source"] == "windowed_delta" and update_mags:
             report["update_mean_magnitudes"] = update_mags
             report["update_param_ratios"] = ratios
         if histograms:

@@ -1,0 +1,357 @@
+"""Device-side training-health tests (docs/OBSERVABILITY.md "Training
+health"): the in-jit per-layer stats on the fused scan path must match
+an eager per-step reference to fp32 tolerance WITHOUT adding dispatches,
+each divergence-guard policy must behave as documented on the per-batch,
+graph, fused-scan and parallel paths (``skip_update`` bit-identical to
+the pre-step params), and the ``/health`` + ``/healthz`` endpoints and
+``train_health_*`` / ``xla_cost_*`` series must reflect a fit."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.ui.server import UIServer
+
+from test_ingest import _data, _flat, _gather_calls, _graph, _mln
+
+
+@pytest.fixture(autouse=True)
+def _isolated_health():
+    monitor.reset()        # also resets the health layer
+    yield
+    monitor.reset()
+
+
+def _nan_data(n=32, n_in=6, n_classes=3):
+    ds = _data(n=n, n_in=n_in, n_classes=n_classes)
+    ds.features[:] = np.nan
+    return ds
+
+
+# ------------------------------------------------- stats correctness
+
+def test_fused_path_stats_match_eager_reference():
+    """Per-step grad-norm / param-norm / update-ratio packed by the
+    fused gather scan == an eager per-step replay of the same program
+    (same rng stream, same updater), to fp32 tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    monitor.health.enable(policy="warn")
+    ds = _data(n=64)
+    net = _mln()
+    ref = _mln()     # identical seed -> identical init
+    np.testing.assert_array_equal(_flat(net.params), _flat(ref.params))
+
+    net.fit(ListDataSetIterator(ds, 16, shuffle=False), epochs=2,
+            ingest="cache")
+    stack = monitor.health.last_stack_for(net)
+    assert stack is not None and stack.shape == (8, 8)  # 2 epochs x 4 steps
+
+    # eager reference: same batch order (shuffle off -> arange perm)
+    X, Y = ds.features, ds.labels
+    params, ustate, state = ref.params, ref.updater_state, ref.net_state
+    g_fn = jax.value_and_grad(ref._loss_fn, has_aux=True)
+    for it in range(8):
+        s = it % 4
+        f = jnp.asarray(X[s * 16:(s + 1) * 16])
+        l = jnp.asarray(Y[s * 16:(s + 1) * 16])
+        rng = jax.random.fold_in(ref._rng_key, it)
+        (loss, (state, _)), grads = g_fn(params, state, f, l, None, None,
+                                         rng, True)
+        new_params, ustate = ref._apply_updates(params, ustate, grads, it)
+
+        def l2(tree):
+            leaves = jax.tree.leaves(tree)
+            if not leaves:
+                return 0.0
+            return float(np.sqrt(sum(
+                float(np.sum(np.square(np.asarray(x, np.float32))))
+                for x in leaves)))
+
+        row = stack[it]
+        assert np.isclose(row[0], float(loss), rtol=1e-4)
+        assert row[1] == 0.0
+        for j in range(2):
+            g_ref = l2(grads[j])
+            p_ref = l2(params[j])
+            u_ref = l2(jax.tree.map(lambda a, b: np.asarray(a, np.float32)
+                                    - np.asarray(b, np.float32),
+                                    params[j], new_params[j]))
+            assert np.isclose(row[2 + j], g_ref, rtol=1e-4), (it, j)
+            assert np.isclose(row[4 + j], p_ref, rtol=1e-4), (it, j)
+            assert np.isclose(row[6 + j], u_ref / (p_ref + 1e-12),
+                              rtol=1e-4), (it, j)
+        params = new_params
+
+
+def test_fusion_still_single_dispatch_with_health():
+    """The ISSUE acceptance bar: health enabled, listener-free no-tail
+    epochs still fold into ONE gather-scan dispatch — the stats ride the
+    scan as an extra output instead of forcing per-step dispatch."""
+    monitor.health.enable(policy="warn")
+    ds = _data(n=64)
+    net = _mln()
+    before = _gather_calls("mln.gather_train_step")
+    net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=3,
+            ingest="cache")
+    assert _gather_calls("mln.gather_train_step") - before == 1
+    # ...and the fetched stack covers every fused step
+    assert monitor.health.last_stack_for(net).shape == (12, 8)
+    assert monitor.health.state() == "ok"
+
+
+# ------------------------------------------------------ guard policies
+
+def test_abort_policy_mln_per_batch():
+    monitor.health.enable(policy="abort")
+    net = _mln()
+    with pytest.raises(monitor.TrainingDivergedError) as err:
+        net.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    assert err.value.step == 0
+    assert err.value.layer == "loss"
+    assert monitor.health.state() == "diverged"
+
+
+def test_abort_policy_graph():
+    monitor.health.enable(policy="abort")
+    g = _graph()
+    with pytest.raises(monitor.TrainingDivergedError) as err:
+        g.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    assert err.value.step == 0
+
+
+def test_abort_policy_fused_scan_within_one_dispatch():
+    """A seeded-NaN run aborts within ONE dispatch of the first
+    non-finite step: the whole 3-epoch fused program is a single
+    dispatch, and its decoded step index is the first flagged one."""
+    monitor.health.enable(policy="abort")
+    ds = _nan_data(n=64)
+    net = _mln()
+    before = _gather_calls("mln.gather_train_step")
+    with pytest.raises(monitor.TrainingDivergedError) as err:
+        net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3),
+                epochs=3, ingest="cache")
+    assert _gather_calls("mln.gather_train_step") - before == 1
+    assert err.value.step == 0
+
+
+def test_skip_update_bit_identical_per_batch():
+    monitor.health.enable(policy="skip_update")
+    net = _mln()
+    p0 = _flat(net.params)
+    net.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    np.testing.assert_array_equal(p0, _flat(net.params))
+    assert monitor.counter("train_health_skipped_steps_total",
+                           "").value() == 2
+    assert monitor.health.state() == "diverged"
+
+
+def test_skip_update_bit_identical_fused_and_graph():
+    monitor.health.enable(policy="skip_update")
+    ds = _nan_data(n=64)
+    net = _mln()
+    p0 = _flat(net.params)
+    net.fit(ListDataSetIterator(ds, 16, shuffle=True, seed=3), epochs=3,
+            ingest="cache")
+    np.testing.assert_array_equal(p0, _flat(net.params))
+
+    g = _graph()
+    g0 = _flat(g.params)
+    g.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    np.testing.assert_array_equal(g0, _flat(g.params))
+
+
+def test_warn_policy_completes_and_publishes():
+    monitor.health.enable(policy="warn")
+    net = _mln()
+    net.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)   # no raise
+    assert monitor.health.state() == "diverged"
+    assert monitor.counter("train_health_nonfinite_steps_total",
+                           "").value() >= 2
+    text = monitor.prometheus_text()
+    assert "train_health_loss" in text
+    assert "train_health_grad_l2" in text
+    assert "train_health_state 1" in text
+    snap = monitor.health.snapshot()
+    assert snap["last_dispatch"]["diverged_at"]["step"] == 0
+    # a clean fit afterwards keeps the sticky diverged state
+    net2 = _mln()
+    net2.fit(ListDataSetIterator(_data(n=32), 16), epochs=1)
+    assert monitor.health.state() == "diverged"
+    monitor.health.reset()
+    assert monitor.health.state() == "ok"
+
+
+def test_grad_norm_limit_triggers_guard():
+    monitor.health.enable(policy="abort", grad_norm_limit=1e-6)
+    net = _mln()
+    with pytest.raises(monitor.TrainingDivergedError) as err:
+        net.fit(ListDataSetIterator(_data(n=32), 16), epochs=1)
+    assert err.value.layer in ("0", "1")
+    assert "limit" in str(err.value)
+
+
+def test_disabled_health_is_inert():
+    """Default-off: fits neither publish train_health gauges nor store a
+    stack, and the guard never engages."""
+    net = _mln()
+    net.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    assert monitor.health.state() == "ok"
+    assert monitor.health.last_stack_for(net) is None
+    assert "train_health_loss" not in monitor.prometheus_text()
+    # the dispatch timestamp is stamped regardless (the /healthz field)
+    assert monitor.health.last_dispatch_timestamp() is not None
+
+
+# ------------------------------------------------------- parallel path
+
+def test_parallel_wrapper_health_pmean():
+    from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper
+
+    monitor.health.enable(policy="warn")
+    net = _mln()
+    pw = (ParallelWrapper.Builder(net).workers(2).averaging_frequency(2)
+          .build())
+    pw.fit(ListDataSetIterator(_data(n=128), 16), epochs=1)
+    stack = monitor.health.last_stack_for(net)
+    assert stack is not None and stack.shape[1] == 8
+    assert monitor.health.state() == "ok"
+    snap = monitor.health.snapshot()
+    assert set(snap["last_dispatch"]["layers"]) == {"0", "1"}
+
+
+def test_parallel_wrapper_nan_flags_all_workers():
+    from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper
+
+    monitor.health.enable(policy="warn")
+    net = _mln()
+    pw = (ParallelWrapper.Builder(net).workers(2).averaging_frequency(2)
+          .build())
+    pw.fit(ListDataSetIterator(_nan_data(n=128), 16), epochs=1)
+    assert monitor.health.state() == "diverged"
+
+
+# ---------------------------------------------------------- endpoints
+
+def test_health_endpoints_reflect_diverged_run():
+    monitor.health.enable(policy="warn")
+    net = _mln()
+    net.fit(ListDataSetIterator(_nan_data(), 16), epochs=1)
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert hz["status"] == "ok"          # liveness stays 200
+        assert hz["health"] == "diverged"
+        assert hz["backend"] == "cpu"
+        assert hz["device_count"] >= 1
+        assert hz["last_dispatch_timestamp"] is not None
+
+        h = json.loads(urllib.request.urlopen(base + "/health").read())
+        assert h["enabled"] is True
+        assert h["policy"] == "warn"
+        assert h["state"] == "diverged"
+        last = h["last_dispatch"]
+        assert last["diverged_at"]["step"] == 0
+        assert set(last["layers"]) == {"0", "1"}
+        for stats in last["layers"].values():
+            assert set(stats) == {"grad_l2", "param_l2", "update_ratio"}
+
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "train_health_state 1" in body
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------- xla cost telemetry
+
+def test_xla_cost_gauges_published_on_compile():
+    net = _mln()
+    net.fit(ListDataSetIterator(_data(n=32), 16), epochs=1,
+            ingest="batch")
+    flops = monitor.gauge("xla_cost_flops", "").value(fn="mln.train_step")
+    if flops == 0.0:
+        pytest.skip("backend does not report cost_analysis flops")
+    assert flops > 0
+    assert monitor.gauge("xla_cost_bytes_accessed", "").value(
+        fn="mln.train_step") > 0
+    assert 'fn="mln.train_step"' in monitor.prometheus_text()
+
+
+def test_aot_compile_publishes_peak_hbm():
+    import jax.numpy as jnp
+
+    net = _mln()
+    ds = _data(n=32)
+    f = jnp.asarray(ds.features[:16][None])
+    l = jnp.asarray(ds.labels[:16][None])
+    net._multi_train_step.lower(
+        net.params, net.updater_state, net.net_state, 0, f, l, None,
+        None, net._rng_key).compile()
+    peak = monitor.gauge("xla_cost_peak_hbm_bytes", "").value(
+        fn="mln.multi_train_step")
+    if peak == 0.0:
+        pytest.skip("backend does not report memory_analysis")
+    assert peak > 0
+
+
+# ----------------------------------------------------------- listeners
+
+def test_pgil_device_columns_when_health_enabled(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners.listeners import (
+        ParamAndGradientIterationListener)
+
+    monitor.health.enable(policy="warn")
+    p = str(tmp_path / "stats.tsv")
+    net = _mln()
+    net.set_listeners(ParamAndGradientIterationListener(
+        iterations=1, file_path=p, output_to_console=False))
+    net.fit(ListDataSetIterator(_data(n=32), 16), epochs=1)
+    lines = open(p).read().strip().split("\n")
+    header = lines[0].split("\t")
+    assert "update_win_mean_abs" in header
+    assert header[-2:] == ["grad_l2_step", "update_ratio_step"]
+    row = lines[1].split("\t")
+    assert len(row) == len(header)
+    # param "0_W" carries layer 0's device grad norm, and it is a number
+    assert float(row[-2]) > 0
+
+
+def test_stats_listener_switches_to_device_stats():
+    from deeplearning4j_tpu.ui.stats_listener import TYPE_ID, StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    def reports(storage, listener):
+        return [u.data for u in storage.get_all_updates(
+            listener.session_id, TYPE_ID, "worker_0")]
+
+    # windowed fallback when health is off
+    storage = InMemoryStatsStorage()
+    listener = StatsListener(storage, update_frequency=1)
+    net = _mln()
+    net.set_listeners(listener)
+    net.fit(ListDataSetIterator(_data(n=32), 16), epochs=2)
+    rs = reports(storage, listener)
+    assert rs and all(
+        r["update_stats_source"] == "windowed_delta" for r in rs)
+    assert "health" not in rs[-1]
+
+    # exact device stats when health is on
+    monitor.health.enable(policy="warn")
+    storage2 = InMemoryStatsStorage()
+    listener2 = StatsListener(storage2, update_frequency=1)
+    net2 = _mln()
+    net2.set_listeners(listener2)
+    net2.fit(ListDataSetIterator(_data(n=32), 16), epochs=2)
+    rs2 = reports(storage2, listener2)
+    assert rs2[-1]["update_stats_source"] == "device_per_step"
+    assert rs2[-1]["health"]["state"] == "ok"
+    ratios = rs2[-1]["update_param_ratios"]
+    # params of one layer share the layer's device ratio
+    assert ratios["0_W"] == ratios["0_b"]
+    assert ratios["0_W"] > 0

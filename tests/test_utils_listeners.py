@@ -104,7 +104,11 @@ class TestParamAndGradientListener:
         lines = open(p).read().strip().split("\n")
         header = lines[0].split("\t")
         assert header[0] == "iteration"
-        assert "param_mean" in header and "update_mean_abs" in header
+        # update columns are labelled as windowed deltas (the exact
+        # per-step columns only appear when the health layer is on)
+        assert "param_mean" in header and "update_win_mean_abs" in header
+        assert "update_mean_abs" not in header
+        assert "grad_l2_step" not in header
         # 4 param tensors (2 layers x W,b) x 3 iterations + header
         assert len(lines) == 1 + 4 * 3
         # update columns become non-zero once a previous snapshot exists

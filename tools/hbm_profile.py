@@ -244,6 +244,21 @@ def main() -> int:
     print(f"# {config}: top {top_n} HBM-consuming ops "
           f"(parsed {total/1e6:.0f} MB/step; XLA cost model "
           f"{cost.get('bytes accessed', 0)/1e6:.0f} MB/step)")
+    # compiler self-reported totals next to the parsed numbers — the
+    # same xla_cost_* series the compile-watch publishes for every
+    # executable (the AOT compile above already fed the gauges)
+    try:
+        mem = compiled.memory_analysis()
+        peak = (mem.argument_size_in_bytes + mem.output_size_in_bytes
+                + mem.temp_size_in_bytes - mem.alias_size_in_bytes)
+        print(f"# cost model: {cost.get('flops', 0)/1e9:.2f} GFLOP/step; "
+              f"memory_analysis peak HBM {peak/1e6:.0f} MB "
+              f"(args {mem.argument_size_in_bytes/1e6:.0f} + outputs "
+              f"{mem.output_size_in_bytes/1e6:.0f} + temps "
+              f"{mem.temp_size_in_bytes/1e6:.0f} - aliased "
+              f"{mem.alias_size_in_bytes/1e6:.0f})")
+    except Exception:
+        pass
     print(f"{'MB':>8}  {'%':>5}  {'class':<8} {'kind':<14} shape")
     by_class = defaultdict(int)
     for b, kind, name, shape in rows:
