@@ -1166,6 +1166,17 @@ def bench_scaling() -> dict:
 
 def main() -> None:
     run_all = "--all" in sys.argv
+    if "--chaos" in sys.argv:
+        # Resilience proof: train a child process, SIGKILL it mid-epoch
+        # via the fault layer, resume from its last checkpoint, and
+        # assert the loss curve + final params match an uninterrupted
+        # run bit-for-bit.  One stdout JSON line; --smoke is accepted
+        # (the workload is already CI-sized).  The CI resilience job
+        # asserts value == 1.
+        from deeplearning4j_tpu.resilience.chaos import run_chaos
+        print(json.dumps(run_chaos(smoke="--smoke" in sys.argv)),
+              flush=True)
+        return
     if "--smoke" in sys.argv:
         # CI smoke: tiny LeNet config, one stdout JSON line — the CI
         # ingest job asserts the step_device_ms field parses.  Runs in

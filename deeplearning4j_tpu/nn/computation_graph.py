@@ -377,14 +377,20 @@ class ComputationGraph:
         the per-input ``(denom, mult, add)``/None tuple fusing the uint8
         wire decode into the gathered batch.  ``health=True`` adds the
         per-step stats stack as a second scan output, keeping the fused
-        multi-epoch program at ONE dispatch per call."""
+        multi-epoch program at ONE dispatch per call.
+
+        ``start``/``run`` (static) carve a sub-range of one epoch's
+        steps for checkpoint-cadence chunking and mid-epoch resume —
+        same bit-identity guarantee as the MLN gather step (identical
+        per-step HLO; the carry chain crosses dispatches exactly)."""
         from . import ingest
         from ..monitor import health as _health
 
         def multi(params, updater_state, net_state, iteration, data_fs,
                   data_ls, base_rng, shuffle_key, first_epoch, fused,
-                  steps, batch, shuffle, tail, wires):
+                  steps, batch, shuffle, tail, wires, start=0, run=None):
             n = data_fs[0].shape[0]
+            span = steps if run is None else run
 
             def epoch_rows(e):
                 if shuffle:
@@ -394,7 +400,8 @@ class ComputationGraph:
                     perm = jnp.arange(n)
                 if tail:
                     return perm[steps * batch:].reshape(1, tail)
-                return perm[:steps * batch].reshape(steps, batch)
+                return perm[start * batch:(start + span) * batch] \
+                    .reshape(span, batch)
 
             rows = jax.vmap(epoch_rows)(first_epoch + jnp.arange(fused))
             rows = rows.reshape((-1,) + rows.shape[2:])
@@ -429,7 +436,8 @@ class ComputationGraph:
             return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="cg.gather_train_step",
-                                    static_argnums=(9, 10, 11, 12, 13),
+                                    static_argnums=(9, 10, 11, 12, 13,
+                                                    15, 16),
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
@@ -443,13 +451,15 @@ class ComputationGraph:
         this one."""
         return self._build_gather_train_step(health=True)
 
-    def _fit_device_cached(self, source, epochs: int):
+    def _fit_device_cached(self, source, epochs: int,
+                           start_step: int = 0, ckpt=None):
         """Graph twin of ``MultiLayerNetwork._fit_device_cached``:
         ``source`` is a vetted ``ListDataSetIterator`` (single-input
         DataSets); the dataset lives on device across fits (uint8 wire
         form when the source carries one) and consecutive epochs fuse
         into single gather-scan dispatches via the shared
-        ``ingest.run_device_cached_fit`` driver."""
+        ``ingest.run_device_cached_fit`` driver, which also owns the
+        ``start_step`` resume offset and ``ckpt`` save cadence."""
         from . import ingest
 
         dev_f, dev_l, wire = ingest.device_cached_arrays(
@@ -458,23 +468,30 @@ class ComputationGraph:
         shuffle_key = jax.random.fold_in(self._rng_key, 0xFFFFFFFF)
         steps = source._ds.num_examples() // source._batch
 
-        def dispatch(first_epoch, fused, tail):
+        def dispatch(first_epoch, fused, tail, start=0, run=None):
             (self.params, self.updater_state, self.net_state,
              scores, health) = self._gather_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, data_fs, data_ls, self._rng_key,
                 shuffle_key, first_epoch, fused, steps, source._batch,
-                bool(source._shuffle), tail, (wire,))
+                bool(source._shuffle), tail, (wire,), start,
+                steps if run is None else run)
             _monitor.health.record_dispatch(self, health, self.iteration)
             return scores
 
-        return ingest.run_device_cached_fit(self, source, epochs, dispatch)
+        return ingest.run_device_cached_fit(self, source, epochs, dispatch,
+                                            start_step=start_step,
+                                            ckpt=ckpt)
 
-    def _fit_windowed(self, iterator, epochs: int, window: int):
+    def _fit_windowed(self, iterator, epochs: int, window: int,
+                      ckpt=None):
         """Graph twin of ``MultiLayerNetwork._fit_windowed``: stream
         (Multi)DataSets in multi-batch windows, host stacking and
-        transfer overlapping the previous window's on-chip scan."""
+        transfer overlapping the previous window's on-chip scan.
+        ``ckpt`` saves at epoch boundaries (mid-epoch offsets are not
+        replayable on this path)."""
         from . import ingest
+        from ..resilience import faults as _faults
 
         replay = ingest.ScoreReplayer(self)
 
@@ -513,6 +530,7 @@ class ComputationGraph:
             self.iteration += len(buf)
             self.last_batch_size = buf[0].num_examples()
 
+        it_mark = self.iteration
         for _ in range(epochs):
             with _monitor.span("fit/epoch", epoch=self.epoch,
                                path="window"):
@@ -541,6 +559,17 @@ class ComputationGraph:
                     if hasattr(listener, "on_epoch_end"):
                         listener.on_epoch_end(self)
                 self.epoch += 1
+            if ckpt is not None:
+                ckpt.note_steps(self.iteration - it_mark)
+                it_mark = self.iteration
+                if ckpt.due(epoch_boundary=True):
+                    replay.replay()
+                    ckpt.save(self, step_in_epoch=0)
+            _faults.maybe_die(self.iteration)
+        if ckpt is not None:
+            replay.replay()
+            ckpt.save_if_progress(self, step_in_epoch=0)
+            ckpt.flush()
         replay.finish()
         return self
 
@@ -818,8 +847,32 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------------- fit
+    def _resolve_resilience(self, checkpoint, resume_from, epochs):
+        """(manager, start_step, remaining_epochs) for ``fit``'s
+        ``checkpoint=``/``resume_from=`` hooks; the no-resilience call
+        stays import-free."""
+        if checkpoint is None and resume_from is None:
+            return None, 0, epochs
+        from ..resilience.checkpoint import resolve_fit_resilience
+        return resolve_fit_resilience(self, checkpoint, resume_from,
+                                      epochs)
+
+    def _warn_partial_epoch_restart(self, start_step: int,
+                                    path: str) -> None:
+        """Mid-epoch resume offsets are only replayable on the
+        epoch-cache path (the shuffle lives in the on-device threefry
+        stream); other paths restart the interrupted epoch."""
+        if start_step:
+            import warnings
+            warnings.warn(
+                f"resume_from checkpoint was taken mid-epoch "
+                f"(step_in_epoch={start_step}) but the {path} path "
+                "cannot seek into an epoch; restarting the epoch from "
+                "step 0 (at-least-once semantics)", RuntimeWarning)
+
     def fit(self, data, labels=None, epochs: int = 1,
-            ingest: str = "auto", window: int = 16) -> "ComputationGraph":
+            ingest: str = "auto", window: int = 16, checkpoint=None,
+            resume_from=None) -> "ComputationGraph":
         """Train (reference ``fit`` variants ``:650-810``).  ``data`` may be
         a (Multi)DataSet, an iterator of them, or features with ``labels``.
 
@@ -831,12 +884,19 @@ class ComputationGraph:
         semantics as :meth:`MultiLayerNetwork.fit` — ``"auto"`` picks
         the device-resident epoch cache when the dataset fits HBM, else
         windowed double-buffered staging; listeners fire by exact
-        per-step score replay."""
+        per-step score replay.
+
+        ``checkpoint=``/``resume_from=``: preemption-safe checkpointing
+        and resume, same semantics as :meth:`MultiLayerNetwork.fit`
+        (``epochs`` is the TOTAL epoch target when resuming; see
+        ``docs/RESILIENCE.md``)."""
         if ingest not in ("auto", "cache", "window", "batch"):
             raise ValueError(
                 f"unknown ingest mode {ingest!r}; expected 'auto', "
                 "'cache', 'window', or 'batch'")
         self.init()
+        ckpt, start_step, epochs = self._resolve_resilience(
+            checkpoint, resume_from, epochs)
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -867,13 +927,20 @@ class ComputationGraph:
                 if ingest in ("auto", "cache"):
                     source = ingest_mod.cacheable_source(iterator)
                     if source is not None:
-                        return self._fit_device_cached(source, epochs)
+                        return self._fit_device_cached(
+                            source, epochs, start_step=start_step,
+                            ckpt=ckpt)
                     if ingest == "cache":
                         raise ValueError(
                             "ingest='cache' but the iterator is not "
                             "device-cacheable (see nn/ingest.py "
                             "eligibility)")
-                return self._fit_windowed(iterator, epochs, window)
+                self._warn_partial_epoch_restart(start_step, "window")
+                return self._fit_windowed(iterator, epochs, window,
+                                          ckpt=ckpt)
+            self._warn_partial_epoch_restart(start_step, "batch")
+            from ..resilience import faults as _faults
+            it_mark = self.iteration
             for _ in range(epochs):
                 with _monitor.span("fit/epoch", epoch=self.epoch,
                                    path="batch"):
@@ -889,6 +956,15 @@ class ComputationGraph:
                         if hasattr(listener, "on_epoch_end"):
                             listener.on_epoch_end(self)
                     self.epoch += 1
+                if ckpt is not None:
+                    ckpt.note_steps(self.iteration - it_mark)
+                    it_mark = self.iteration
+                    if ckpt.due(epoch_boundary=True):
+                        ckpt.save(self, step_in_epoch=0)
+                _faults.maybe_die(self.iteration)
+            if ckpt is not None:
+                ckpt.save_if_progress(self, step_in_epoch=0)
+                ckpt.flush()
             return self
         finally:
             finalize_listeners(self.listeners)

@@ -380,22 +380,38 @@ class ScoreReplayer:
             self._pending = []
 
 
-def run_device_cached_fit(model, u, epochs: int, dispatch):
+def run_device_cached_fit(model, u, epochs: int, dispatch, *,
+                          start_step: int = 0, ckpt=None):
     """Shared MLN/ComputationGraph driver for the device-resident
     epoch-cache fit.  ``u`` is the vetted ``ListDataSetIterator``;
-    ``dispatch(first_epoch, fused_epochs, tail)`` invokes the model's
-    gather-scan train step (which derives each epoch's permutation on
-    device — see ``_gather_train_step``) and returns per-step scores.
+    ``dispatch(first_epoch, fused_epochs, tail, start, run)`` invokes
+    the model's gather-scan train step (which derives each epoch's
+    permutation on device — see ``_gather_train_step``) and returns
+    per-step scores; ``start``/``run`` select a sub-range of the
+    epoch's full-batch steps so a dispatch can begin mid-epoch.
 
-    One call per epoch normally; when no listeners are attached and the
-    batch divides the dataset (no tail), up to
-    :func:`max_steps_per_dispatch` steps' worth of CONSECUTIVE epochs
-    fold into a single dispatch — multi-epoch fits become a handful of
-    XLA invocations with zero host traffic between them.  Listeners
-    force per-epoch dispatches so score replay and epoch callbacks keep
-    their per-iteration/per-epoch semantics.  A tail batch runs as its
-    own 1-step dispatch (same on-device permutation, last ``tail``
-    entries), preserving the per-batch path's batch boundaries."""
+    One call per epoch normally; when no listeners are attached, the
+    batch divides the dataset (no tail), and no step-cadence checkpoint
+    is active, up to :func:`max_steps_per_dispatch` steps' worth of
+    CONSECUTIVE epochs fold into a single dispatch — multi-epoch fits
+    become a handful of XLA invocations with zero host traffic between
+    them.  Listeners force per-epoch dispatches so score replay and
+    epoch callbacks keep their per-iteration/per-epoch semantics.  A
+    tail batch runs as its own 1-step dispatch (same on-device
+    permutation, last ``tail`` entries), preserving the per-batch
+    path's batch boundaries.
+
+    Resilience hooks: ``start_step`` (from a restored checkpoint's
+    ``step_in_epoch``) starts the FIRST epoch at that scan offset —
+    the permutation is recomputed from the same threefry key, so the
+    split epoch trains the identical step sequence an uninterrupted
+    run would have, then later epochs return to full fusion.  ``ckpt``
+    (a ``resilience.CheckpointManager``) bounds dispatch chunks to the
+    step cadence, saves when due (scores are replayed first so
+    listener output is never ahead of a checkpoint), and gives the
+    fault layer its preemption point *after* each save."""
+    from ..resilience import faults as _faults
+
     replay = ScoreReplayer(model)
     iters = _monitor.counter("train_iterations_total",
                              "supervised train iterations")
@@ -403,33 +419,68 @@ def run_device_cached_fit(model, u, epochs: int, dispatch):
     batch = u._batch
     steps, tail = divmod(n, batch)
     fuse_cap = max(1, max_steps_per_dispatch() // max(1, steps))
+    pos = int(start_step)
+    if pos < 0 or pos >= steps:
+        pos = 0
+    step_cadence = (getattr(ckpt, "every_steps", None)
+                    if ckpt is not None else None)
+
+    def maybe_save(step_in_epoch, epoch_boundary=False):
+        if ckpt is not None and ckpt.due(epoch_boundary=epoch_boundary):
+            replay.replay()  # flush scores; listeners never trail a save
+            ckpt.save(model, step_in_epoch=step_in_epoch)
+
     done = 0
     while done < epochs:
         fuse = 1
-        if not model.listeners and tail == 0 and steps > 0:
+        if (not model.listeners and tail == 0 and steps > 0 and pos == 0
+                and step_cadence is None):
             fuse = min(epochs - done, fuse_cap)
         with _monitor.span("fit/epoch", epoch=model.epoch, path="cache",
-                           fused=fuse):
-            for listener in model.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(model)
+                           fused=fuse, start=pos):
+            if pos == 0:
+                for listener in model.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(model)
             t0 = time.perf_counter()
             for _ in range(fuse):
                 consume_epoch(u)
             _monitor.observe_phase("data", time.perf_counter() - t0)
             t1 = time.perf_counter()
-            if steps:
-                scores = dispatch(model.epoch, fuse, 0)
+            if steps and (pos or step_cadence is not None):
+                # resumed and/or checkpointed epoch: chunked dispatches
+                # over [pos, steps), each chunk ending on a save point
+                while pos < steps:
+                    run = steps - pos
+                    if step_cadence is not None:
+                        run = min(run, ckpt.steps_to_next_save())
+                    scores = dispatch(model.epoch, 1, 0, pos, run)
+                    replay.add(model.iteration, scores)
+                    iters.inc(run)
+                    model.iteration += run
+                    model.last_batch_size = batch
+                    pos += run
+                    if ckpt is not None:
+                        ckpt.note_steps(run)
+                    if pos < steps:
+                        maybe_save(pos)
+                        _faults.maybe_die(model.iteration)
+            elif steps:
+                scores = dispatch(model.epoch, fuse, 0, 0, steps)
                 replay.add(model.iteration, scores)
                 iters.inc(fuse * steps)
                 model.iteration += fuse * steps
                 model.last_batch_size = batch
+                if ckpt is not None:
+                    ckpt.note_steps(fuse * steps)
             if tail:
-                scores = dispatch(model.epoch, 1, tail)
+                scores = dispatch(model.epoch, 1, tail, 0, 0)
                 replay.add(model.iteration, scores)
                 iters.inc(1)
                 model.iteration += 1
                 model.last_batch_size = tail
+                if ckpt is not None:
+                    ckpt.note_steps(1)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             if model.listeners:
                 t2 = time.perf_counter()
@@ -440,6 +491,13 @@ def run_device_cached_fit(model, u, epochs: int, dispatch):
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(model)
             model.epoch += fuse
+            pos = 0
         done += fuse
+        maybe_save(0, epoch_boundary=True)
+        _faults.maybe_die(model.iteration)
+    if ckpt is not None:
+        replay.replay()
+        ckpt.save_if_progress(model, step_in_epoch=0)
+        ckpt.flush()
     replay.finish()
     return model

@@ -343,11 +343,18 @@ class MultiLayerNetwork:
         (``ingest.device_decode``).
 
         Static args (``fused``/``steps``/``batch``/``shuffle``/
-        ``tail``) fix the program shape; ``first_epoch`` stays dynamic
-        (weak int32) so advancing epochs never retraces.  ``tail > 0``
-        selects the 1-step tail dispatch: the SAME epoch permutation is
-        recomputed and its last ``tail`` entries form the ragged final
-        batch, keeping v1's batch boundaries.
+        ``tail``/``start``/``run``) fix the program shape;
+        ``first_epoch`` stays dynamic (weak int32) so advancing epochs
+        never retraces.  ``tail > 0`` selects the 1-step tail dispatch:
+        the SAME epoch permutation is recomputed and its last ``tail``
+        entries form the ragged final batch, keeping v1's batch
+        boundaries.  ``start``/``run`` select the sub-range
+        ``[start, start+run)`` of the epoch's full-batch steps — the
+        preemption-resume hook: a checkpoint restored mid-epoch
+        re-derives the SAME permutation and scans from the saved
+        offset, so the split epoch is bit-identical to the fused one
+        (the scan body compiles to the same per-step HLO regardless of
+        trip count, and the carry chain crosses dispatches exactly).
 
         ``health=True`` adds the (S, 2+3L) packed per-step health stack
         as a second scan output, fetched once per dispatch — the fused
@@ -357,8 +364,9 @@ class MultiLayerNetwork:
 
         def multi(params, updater_state, net_state, iteration, data_f,
                   data_l, base_rng, shuffle_key, first_epoch, fused,
-                  steps, batch, shuffle, tail, wire):
+                  steps, batch, shuffle, tail, wire, start=0, run=None):
             n = data_f.shape[0]
+            span = steps if run is None else run
 
             def epoch_rows(e):
                 if shuffle:
@@ -368,7 +376,8 @@ class MultiLayerNetwork:
                     perm = jnp.arange(n)
                 if tail:
                     return perm[steps * batch:].reshape(1, tail)
-                return perm[:steps * batch].reshape(steps, batch)
+                return perm[start * batch:(start + span) * batch] \
+                    .reshape(span, batch)
 
             rows = jax.vmap(epoch_rows)(first_epoch + jnp.arange(fused))
             rows = rows.reshape((-1,) + rows.shape[2:])
@@ -401,7 +410,8 @@ class MultiLayerNetwork:
             return params, updater_state, net_state, scores, hstack
 
         return _monitor.watched_jit(multi, name="mln.gather_train_step",
-                                    static_argnums=(9, 10, 11, 12, 13),
+                                    static_argnums=(9, 10, 11, 12, 13,
+                                                    15, 16),
                                     donate_argnums=(0, 1, 2))
 
     @functools.cached_property
@@ -415,7 +425,8 @@ class MultiLayerNetwork:
         this one."""
         return self._build_gather_train_step(health=True)
 
-    def _fit_device_cached(self, source, epochs: int):
+    def _fit_device_cached(self, source, epochs: int,
+                           start_step: int = 0, ckpt=None):
         """One ``fit`` over a device-resident dataset (see
         ``_gather_train_step``).  ``source`` is the underlying
         ``ListDataSetIterator`` vetted by ``ingest.cacheable_source``.
@@ -425,7 +436,9 @@ class MultiLayerNetwork:
         stream (keyed off the fit RNG, continuing across fits via
         ``self.epoch``) — parity-tested against a host replay of the
         same permutations.  Listeners fire per iteration by replaying
-        the scanned scores."""
+        the scanned scores.  ``start_step``/``ckpt`` are the resume
+        offset and checkpoint manager threaded through to the shared
+        driver (``ingest.run_device_cached_fit``)."""
         from . import ingest
 
         data_f, data_l, wire = ingest.device_cached_arrays(
@@ -433,26 +446,34 @@ class MultiLayerNetwork:
         shuffle_key = jax.random.fold_in(self._rng_key, 0xFFFFFFFF)
         steps = source._ds.num_examples() // source._batch
 
-        def dispatch(first_epoch, fused, tail):
+        def dispatch(first_epoch, fused, tail, start=0, run=None):
             (self.params, self.updater_state, self.net_state,
              scores, health) = self._gather_train_step_h(
                 self.params, self.updater_state, self.net_state,
                 self.iteration, data_f, data_l, self._rng_key,
                 shuffle_key, first_epoch, fused, steps, source._batch,
-                bool(source._shuffle), tail, wire)
+                bool(source._shuffle), tail, wire, start,
+                steps if run is None else run)
             _monitor.health.record_dispatch(self, health, self.iteration)
             return scores
 
-        return ingest.run_device_cached_fit(self, source, epochs, dispatch)
+        return ingest.run_device_cached_fit(self, source, epochs, dispatch,
+                                            start_step=start_step,
+                                            ckpt=ckpt)
 
-    def _fit_windowed(self, iterator, epochs: int, window: int):
+    def _fit_windowed(self, iterator, epochs: int, window: int,
+                      ckpt=None):
         """Streaming ``fit(iterator)`` in multi-batch windows: the host
         stacks window k+1 (numpy) and enqueues its transfer while window
         k's multi-step scan runs on-chip — JAX async dispatch provides
         the overlap, nothing blocks until scores are fetched (the
         double-buffered-staging half of the ingest design; datasets that
-        fit HBM take ``_fit_device_cached`` instead)."""
+        fit HBM take ``_fit_device_cached`` instead).  ``ckpt`` saves at
+        epoch boundaries (windows re-stack from the host iterator, so
+        mid-epoch offsets are not replayable here — the epoch-cache
+        path owns exact mid-epoch resume)."""
         from . import ingest
+        from ..resilience import faults as _faults
 
         replay = ingest.ScoreReplayer(self)
 
@@ -488,6 +509,7 @@ class MultiLayerNetwork:
             self.iteration += len(buf)
             self.last_batch_size = buf[0].num_examples()
 
+        it_mark = self.iteration
         for _ in range(epochs):
             with _monitor.span("fit/epoch", epoch=self.epoch,
                                path="window"):
@@ -515,6 +537,17 @@ class MultiLayerNetwork:
                     if hasattr(listener, "on_epoch_end"):
                         listener.on_epoch_end(self)
                 self.epoch += 1
+            if ckpt is not None:
+                ckpt.note_steps(self.iteration - it_mark)
+                it_mark = self.iteration
+                if ckpt.due(epoch_boundary=True):
+                    replay.replay()
+                    ckpt.save(self, step_in_epoch=0)
+            _faults.maybe_die(self.iteration)
+        if ckpt is not None:
+            replay.replay()
+            ckpt.save_if_progress(self, step_in_epoch=0)
+            ckpt.flush()
         replay.finish()
         return self
 
@@ -797,9 +830,33 @@ class MultiLayerNetwork:
         return self
 
     # ------------------------------------------------------------------- fit
+    def _resolve_resilience(self, checkpoint, resume_from, epochs):
+        """(manager, start_step, remaining_epochs) for ``fit``'s
+        ``checkpoint=``/``resume_from=`` hooks; the no-resilience call
+        stays import-free."""
+        if checkpoint is None and resume_from is None:
+            return None, 0, epochs
+        from ..resilience.checkpoint import resolve_fit_resilience
+        return resolve_fit_resilience(self, checkpoint, resume_from,
+                                      epochs)
+
+    def _warn_partial_epoch_restart(self, start_step: int,
+                                    path: str) -> None:
+        """Mid-epoch resume offsets are only replayable on the
+        epoch-cache path (the shuffle lives in the on-device threefry
+        stream); other paths restart the interrupted epoch."""
+        if start_step:
+            import warnings
+            warnings.warn(
+                f"resume_from checkpoint was taken mid-epoch "
+                f"(step_in_epoch={start_step}) but the {path} path "
+                "cannot seek into an epoch; restarting the epoch from "
+                "step 0 (at-least-once semantics)", RuntimeWarning)
+
     def fit(self, data, labels=None, epochs: int = 1,
             ingest: str = "auto",
-            window: int = 16) -> "MultiLayerNetwork":
+            window: int = 16, checkpoint=None,
+            resume_from=None) -> "MultiLayerNetwork":
         """Train (reference ``fit(DataSetIterator):976`` /
         ``fit(INDArray,INDArray):1406``).
 
@@ -825,12 +882,28 @@ class MultiLayerNetwork:
         by a replayed listener are end-of-dispatch — the ``fit_scan``
         compromise).  Solver/tBPTT/num_iterations>1 configs always use
         the per-batch path.
+
+        Resilience (``docs/RESILIENCE.md``): ``checkpoint=`` (a
+        ``resilience.CheckpointManager`` or a directory) saves
+        preemption-safe checkpoints at the manager's step/second
+        cadence (epoch boundaries by default); ``resume_from=``
+        (``"auto"``, a directory, or a checkpoint path) restores
+        params/updater/RNG/progress before training.  With
+        ``resume_from``, ``epochs`` is the TOTAL epoch target the
+        original run aimed for — the restored epoch counter determines
+        how much work remains, so callers re-issue the identical fit
+        call after a preemption.  On the epoch-cache path a mid-epoch
+        restore resumes at the exact fused-scan step offset
+        (bit-identical to the uninterrupted run); the window/batch
+        paths restart the interrupted epoch from its beginning.
         """
         if ingest not in ("auto", "cache", "window", "batch"):
             raise ValueError(
                 f"unknown ingest mode {ingest!r}; expected 'auto', "
                 "'cache', 'window', or 'batch'")
         self.init()
+        ckpt, start_step, epochs = self._resolve_resilience(
+            checkpoint, resume_from, epochs)
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         if isinstance(data, DataSet):
@@ -862,14 +935,21 @@ class MultiLayerNetwork:
                 if ingest in ("auto", "cache"):
                     source = ingest_mod.cacheable_source(iterator)
                     if source is not None:
-                        return self._fit_device_cached(source, epochs)
+                        return self._fit_device_cached(
+                            source, epochs, start_step=start_step,
+                            ckpt=ckpt)
                     if ingest == "cache":
                         raise ValueError(
                             "ingest='cache' but the iterator is not "
                             "device-cacheable (see nn/ingest.py "
                             "eligibility)")
-                return self._fit_windowed(iterator, epochs, window)
+                self._warn_partial_epoch_restart(start_step, "window")
+                return self._fit_windowed(iterator, epochs, window,
+                                          ckpt=ckpt)
 
+            self._warn_partial_epoch_restart(start_step, "batch")
+            from ..resilience import faults as _faults
+            it_mark = self.iteration
             for _ in range(epochs):
                 with _monitor.span("fit/epoch", epoch=self.epoch,
                                    path="batch"):
@@ -885,6 +965,15 @@ class MultiLayerNetwork:
                         if hasattr(listener, "on_epoch_end"):
                             listener.on_epoch_end(self)
                     self.epoch += 1
+                if ckpt is not None:
+                    ckpt.note_steps(self.iteration - it_mark)
+                    it_mark = self.iteration
+                    if ckpt.due(epoch_boundary=True):
+                        ckpt.save(self, step_in_epoch=0)
+                _faults.maybe_die(self.iteration)
+            if ckpt is not None:
+                ckpt.save_if_progress(self, step_in_epoch=0)
+                ckpt.flush()
             return self
         finally:
             finalize_listeners(self.listeners)

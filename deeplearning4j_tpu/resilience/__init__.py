@@ -1,0 +1,36 @@
+"""Fault-tolerant training runtime.
+
+Three legs (see ``docs/RESILIENCE.md``):
+
+- :mod:`.checkpoint` — preemption-safe checkpointing: atomic
+  temp+fsync+rename zip writes with a per-entry SHA-256 manifest,
+  rolling ``keep_last``/``keep_best`` retention, a background writer
+  thread, and full fit-resume state (params, updater, layer state, fit
+  RNG key, epoch/iteration and the fused-scan step offset) so
+  kill-and-resume is bit-identical to an uninterrupted run on the
+  epoch-cache path.
+- :mod:`.faults` — deterministic fault injection
+  (``die_at_step`` / ``corrupt_checkpoint`` / ``drop_connection`` /
+  ``slow_worker_ms``) behind ``DL4J_TPU_FAULT_*`` env vars, counted in
+  the metrics registry.
+- :mod:`.chaos` — the kill/resume parity harness: trains a small model
+  in a subprocess, SIGKILLs it mid-epoch via a fault point, resumes
+  from the last checkpoint, and asserts the per-step loss sequence and
+  final params match an uninterrupted run bit-for-bit
+  (``bench.py --chaos``).
+
+The hardened scaleout wire (framed reads, retry/backoff, idempotent
+pushes) lives with its transport in ``scaleout/param_server.py`` and
+``streaming/broker.py``; its fault hooks come from :mod:`.faults`.
+"""
+
+from . import faults
+from .checkpoint import (CheckpointCorruptError, CheckpointManager,
+                         ResumeState, as_manager, list_checkpoints, restore,
+                         verify_checkpoint)
+
+__all__ = [
+    "CheckpointCorruptError", "CheckpointManager", "ResumeState",
+    "as_manager", "faults", "list_checkpoints", "restore",
+    "verify_checkpoint",
+]

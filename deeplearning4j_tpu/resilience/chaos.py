@@ -1,0 +1,237 @@
+"""Kill/resume parity harness: the executable proof of preemption
+safety.
+
+The claim under test (ROADMAP item 1's acceptance bar): a training run
+SIGKILLed mid-epoch and resumed from its last checkpoint produces a
+per-step loss sequence **bit-identical (fp32)** to the same run left
+uninterrupted, on the fused-scan epoch-cache path.
+
+The harness runs the same tiny-MLN training child three times:
+
+1. *reference* — to completion, no faults;
+2. *victim* — with ``DL4J_TPU_FAULT_DIE_AT_STEP`` armed so the fault
+   layer SIGKILLs the process mid-epoch (after a mid-epoch checkpoint
+   exists — the fault point sits after the checkpoint hook, like a real
+   preemption notice arriving between steps);
+3. *resume* — same working directory, ``--resume``: restores the newest
+   valid checkpoint and trains to the same total-epoch target.
+
+Each child appends ``{"iteration": i, "score": s}`` JSONL per step
+(flushed per line, so the victim's partial trace survives the SIGKILL)
+and writes ``done.json`` with a SHA-256 of the final fp32 flat params.
+Parity: every iteration 1..total is covered, overlapping iterations
+(steps the victim ran past its last checkpoint, re-run by the resume)
+agree bitwise, and the final param hashes match.
+
+Used by ``bench.py --chaos`` and ``tests/test_resilience.py``; the
+child entry point is ``python -m deeplearning4j_tpu.resilience.chaos``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Dict, Optional
+
+SCORES_JSONL = "scores.jsonl"
+DONE_JSON = "done.json"
+CKPT_DIR = "checkpoints"
+
+
+def build_net(seed: int = 7, n_in: int = 6, n_classes: int = 3):
+    """Deterministic small MLN (CPU-friendly; fused-scan eligible)."""
+    from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
+    from ..nn.conf import inputs
+    from ..nn.layers.core import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater("adam").learning_rate(0.05)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=n_classes))
+            .set_input_type(inputs.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_iterator(n: int = 64, n_in: int = 6, n_classes: int = 3,
+                   batch: int = 8, seed: int = 0):
+    """Deterministic synthetic dataset on the device-cacheable path
+    (shuffle order itself comes from the on-device threefry stream)."""
+    import numpy as np
+
+    from ..datasets.dataset import DataSet
+    from ..datasets.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[
+        rng.randint(0, n_classes, n)]
+    return ListDataSetIterator(DataSet(X, y), batch, shuffle=True, seed=3)
+
+
+class _ScoreTap:
+    """Listener appending per-iteration scores as JSONL, one flushed
+    line per step so a SIGKILL loses nothing already replayed."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "a", buffering=1)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        score = float(model._score) if model._score is not None else None
+        self._fh.write(json.dumps({"iteration": int(iteration),
+                                   "score": score}) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+
+def _params_sha256(net) -> str:
+    import numpy as np
+    flat = np.asarray(net.get_flat_params(), "<f4")
+    return hashlib.sha256(flat.tobytes()).hexdigest()
+
+
+def child_main(workdir: str, epochs: int, every_steps: int,
+               resume: bool) -> int:
+    """The training child (runs in its own process; the die fault is
+    armed via the environment by the parent)."""
+    from .checkpoint import CheckpointManager
+
+    net = build_net()
+    it = build_iterator()
+    net.set_listeners(_ScoreTap(os.path.join(workdir, SCORES_JSONL)))
+    ckpt = CheckpointManager(os.path.join(workdir, CKPT_DIR),
+                             every_steps=every_steps, keep_last=4)
+    net.fit(it, epochs=epochs, checkpoint=ckpt,
+            resume_from="auto" if resume else None)
+    with open(os.path.join(workdir, DONE_JSON), "w") as fh:
+        json.dump({"params_sha256": _params_sha256(net),
+                   "iteration": int(net.iteration),
+                   "epoch": int(net.epoch),
+                   "score": float(net.score())}, fh)
+    return 0
+
+
+def run_child(workdir: str, epochs: int, every_steps: int,
+              resume: bool = False,
+              die_at_step: Optional[int] = None,
+              timeout: float = 300.0) -> subprocess.CompletedProcess:
+    """Launch the training child as a subprocess (CPU backend; the die
+    fault armed via ``DL4J_TPU_FAULT_DIE_AT_STEP``)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("DL4J_TPU_FAULT_DIE_AT_STEP", None)
+    if die_at_step is not None:
+        env["DL4J_TPU_FAULT_DIE_AT_STEP"] = str(die_at_step)
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu.resilience.chaos",
+           "--workdir", workdir, "--epochs", str(epochs),
+           "--every-steps", str(every_steps)]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(cmd, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def read_scores(workdir: str) -> Dict[int, float]:
+    """iteration -> score; later lines (the resumed run re-covering
+    steps past the last checkpoint) override earlier ones."""
+    out: Dict[int, float] = {}
+    path = os.path.join(workdir, SCORES_JSONL)
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            out[int(rec["iteration"])] = rec["score"]
+    return out
+
+
+def run_chaos(workdir: Optional[str] = None, epochs: int = 3,
+              every_steps: int = 3,
+              die_at_step: Optional[int] = None,
+              smoke: bool = False) -> Dict:
+    """Full kill/resume parity experiment; returns the bench record
+    (``parity`` is the headline boolean).  ``smoke`` shrinks nothing —
+    the workload is already tier-1 sized — but is accepted for CLI
+    symmetry with the other bench modes."""
+    del smoke
+    it = build_iterator()
+    steps_per_epoch = it._ds.num_examples() // it._batch
+    total = epochs * steps_per_epoch \
+        + epochs * (1 if it._ds.num_examples() % it._batch else 0)
+    if die_at_step is None:
+        # mid-epoch (second epoch), past at least one mid-epoch save
+        die_at_step = steps_per_epoch + every_steps + 2
+    own_tmp = workdir is None
+    if own_tmp:
+        workdir = tempfile.mkdtemp(prefix="dl4j-chaos-")
+    ref_dir = os.path.join(workdir, "ref")
+    kill_dir = os.path.join(workdir, "kill")
+    os.makedirs(ref_dir, exist_ok=True)
+    os.makedirs(kill_dir, exist_ok=True)
+
+    ref = run_child(ref_dir, epochs, every_steps)
+    if ref.returncode != 0:
+        raise RuntimeError(f"reference run failed:\n{ref.stderr[-4000:]}")
+    victim = run_child(kill_dir, epochs, every_steps,
+                       die_at_step=die_at_step)
+    killed = victim.returncode != 0
+    resumed = run_child(kill_dir, epochs, every_steps, resume=True)
+    if resumed.returncode != 0:
+        raise RuntimeError(f"resume run failed:\n{resumed.stderr[-4000:]}")
+
+    scores_ref = read_scores(ref_dir)
+    scores_res = read_scores(kill_dir)
+    with open(os.path.join(ref_dir, DONE_JSON)) as fh:
+        done_ref = json.load(fh)
+    with open(os.path.join(kill_dir, DONE_JSON)) as fh:
+        done_res = json.load(fh)
+
+    covered = set(scores_res) == set(range(1, total + 1)) \
+        and set(scores_ref) == set(range(1, total + 1))
+    mismatches = [i for i in scores_ref
+                  if scores_res.get(i) != scores_ref[i]]
+    params_match = done_ref["params_sha256"] == done_res["params_sha256"]
+    parity = covered and not mismatches and params_match
+    return {
+        "metric": "chaos_kill_resume_parity",
+        "value": 1 if parity else 0,
+        "unit": "bool",
+        "parity": parity,
+        "victim_killed": killed,
+        "victim_returncode": victim.returncode,
+        "die_at_step": die_at_step,
+        "total_steps": total,
+        "steps_compared": len(scores_ref),
+        "score_mismatches": len(mismatches),
+        "coverage_ok": covered,
+        "params_match": params_match,
+        "workdir": workdir,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="chaos training child (see module docstring)")
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--every-steps", type=int, default=3)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args(argv)
+    return child_main(args.workdir, args.epochs, args.every_steps,
+                      args.resume)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

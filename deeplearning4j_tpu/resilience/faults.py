@@ -1,0 +1,156 @@
+"""Deterministic fault injection for the fault-tolerance test surface.
+
+Chaos engineering needs *reproducible* failures: a preemption that lands
+at the same training step every run, a checkpoint that is corrupted the
+same way, a network drop that severs the same push.  This module is the
+single registry of those fault points; production code calls the cheap
+``maybe_*``/``*_enabled`` probes at well-defined places and the probes
+are no-ops unless a fault was armed via environment variables
+(``DL4J_TPU_FAULT_*``, read at import and on :func:`reset`) or
+programmatically via :func:`configure` (tests).
+
+Fault points:
+
+``die_at_step``       SIGKILL this process the first time
+                      :func:`maybe_die` sees ``step >= die_at_step`` —
+                      the preemption simulator (no atexit handlers, no
+                      flushing: exactly what a preempted VM looks like).
+``corrupt_checkpoint``  a token count; each token makes the checkpoint
+                      writer flip a byte in the finalized file — the
+                      bit-rot simulator for detection tests.
+``drop_connection``   a token count; each token makes the param-server
+                      client sever its socket after a request is on the
+                      wire but before the ack — the retry/idempotency
+                      exerciser.
+``slow_worker_ms``    sleep this long at each worker loop head — the
+                      straggler simulator.
+
+Every injection increments ``fault_injections_total{point=...}`` in the
+metrics registry (except ``die_at_step``, whose process is gone before
+any scrape).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from .. import monitor as _monitor
+
+ENV_PREFIX = "DL4J_TPU_FAULT_"
+INJECTIONS_TOTAL = "fault_injections_total"
+_HELP = "deterministic fault injections fired, by fault point"
+
+_lock = threading.Lock()
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(ENV_PREFIX + name)
+    return None if raw in (None, "") else int(raw)
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(ENV_PREFIX + name)
+    return None if raw in (None, "") else float(raw)
+
+
+def _from_env() -> dict:
+    return {
+        "die_at_step": _env_int("DIE_AT_STEP"),
+        "corrupt_checkpoint": _env_int("CORRUPT_CHECKPOINT") or 0,
+        "drop_connection": _env_int("DROP_CONNECTION") or 0,
+        "slow_worker_ms": _env_float("SLOW_WORKER_MS") or 0.0,
+    }
+
+
+_spec = _from_env()
+
+
+def configure(die_at_step: Optional[int] = None,
+              corrupt_checkpoint: int = 0,
+              drop_connection: int = 0,
+              slow_worker_ms: float = 0.0) -> None:
+    """Arm fault points programmatically (tests); overrides the env."""
+    with _lock:
+        _spec["die_at_step"] = die_at_step
+        _spec["corrupt_checkpoint"] = int(corrupt_checkpoint)
+        _spec["drop_connection"] = int(drop_connection)
+        _spec["slow_worker_ms"] = float(slow_worker_ms)
+
+
+def reset() -> None:
+    """Re-read the env (drops any :func:`configure` overrides)."""
+    with _lock:
+        _spec.clear()
+        _spec.update(_from_env())
+
+
+def spec() -> dict:
+    with _lock:
+        return dict(_spec)
+
+
+def _fired(point: str) -> None:
+    _monitor.counter(INJECTIONS_TOTAL, _HELP).inc(point=point)
+
+
+def maybe_die(step: int) -> None:
+    """Preemption point: SIGKILL this process once ``step`` reaches the
+    armed threshold.  Call sites place this *after* their checkpoint
+    hook so the simulated preemption always has the most recent
+    checkpoint behind it (matching a real preemption notice arriving
+    between steps)."""
+    with _lock:
+        at = _spec.get("die_at_step")
+    if at is not None and step >= at:
+        _fired("die_at_step")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def corrupt_checkpoint() -> bool:
+    """Consume one corrupt-checkpoint token (checkpoint writer)."""
+    with _lock:
+        if _spec.get("corrupt_checkpoint", 0) <= 0:
+            return False
+        _spec["corrupt_checkpoint"] -= 1
+    _fired("corrupt_checkpoint")
+    return True
+
+
+def drop_connection() -> bool:
+    """Consume one drop-connection token (param-server client)."""
+    with _lock:
+        if _spec.get("drop_connection", 0) <= 0:
+            return False
+        _spec["drop_connection"] -= 1
+    _fired("drop_connection")
+    return True
+
+
+def slow_worker() -> None:
+    """Straggler point: sleep ``slow_worker_ms`` if armed."""
+    with _lock:
+        ms = _spec.get("slow_worker_ms", 0.0)
+    if ms and ms > 0:
+        _fired("slow_worker_ms")
+        time.sleep(ms / 1000.0)
+
+
+def corrupt_file(path: str) -> None:
+    """Flip one byte in the middle of ``path`` (the bit-rot injector the
+    checkpoint writer and tests share — deterministic position so a
+    corrupted file is corrupted the same way every run)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    pos = size // 2
+    with open(path, "r+b") as fh:
+        fh.seek(pos)
+        b = fh.read(1)
+        fh.seek(pos)
+        fh.write(bytes([b[0] ^ 0xFF]))
+        fh.flush()
+        os.fsync(fh.fileno())

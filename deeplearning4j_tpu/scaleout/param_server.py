@@ -25,14 +25,20 @@ server at all — keeps the Aeron push/pull surface with two transports:
 
 from __future__ import annotations
 
+import collections
+import itertools
+import random
 import socket
 import struct
 import threading
-from typing import Callable, List, Optional
+import time
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
+from ..resilience import faults as _faults
 
 
 class ParameterServer:
@@ -77,17 +83,61 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# Wire v2 (hardened): every request is a self-delimiting frame
+#   op(1) ‖ u64 req_id ‖ u64 payload_len ‖ payload
+# and every response is
+#   status(1: K ok / E rejected) ‖ u64 payload_len ‖ payload
+# so both sides always know exactly how many bytes the peer owes them —
+# a peer dying mid-message leaves a short read (ConnectionError), never
+# a desynchronized stream.  ``req_id`` makes pushes idempotent: a client
+# that times out after the server applied its delta retries with the
+# SAME id and the server acks without re-applying.
+_HEADER = struct.Struct(">cQQ")
+_RESP_HEADER = struct.Struct(">cQ")
+
+
+def _read_frame(conn: socket.socket):
+    """One request frame, or ``None`` on clean EOF at a frame boundary
+    (mid-frame EOF raises ConnectionError — the caller counts it)."""
+    first = conn.recv(1)
+    if not first:
+        return None
+    op, req_id, n = _HEADER.unpack(first + _recv_exact(
+        conn, _HEADER.size - 1))
+    payload = _recv_exact(conn, n) if n else b""
+    return op, req_id, payload
+
+
+def _send_frame(conn: socket.socket, op: bytes, req_id: int,
+                payload: bytes = b"") -> None:
+    conn.sendall(_HEADER.pack(op, req_id, len(payload)) + payload)
+
+
+def _send_response(conn: socket.socket, status: bytes,
+                   payload: bytes = b"") -> None:
+    conn.sendall(_RESP_HEADER.pack(status, len(payload)) + payload)
+
+
+def _read_response(conn: socket.socket) -> Tuple[bytes, bytes]:
+    status, n = _RESP_HEADER.unpack(_recv_exact(conn, _RESP_HEADER.size))
+    return status, (_recv_exact(conn, n) if n else b"")
+
+
 class TcpParameterServer:
     """Socket front-end over a :class:`ParameterServer` — the
     cross-process transport (reference: the embedded Aeron MediaDriver +
     ``ParameterServerNode``, ``ParameterServerParallelWrapper.java:161``).
 
-    Wire protocol (all integers big-endian u64):
-    ``P``               -> reply: len ‖ f64 param bytes     (pull)
-    ``U`` len ‖ bytes   -> reply: ``K`` ok / ``E`` rejected (push delta)
-    ``S``               -> reply: u64 push count            (stats)
-    ``Q`` / EOF         -> close connection
+    Wire v2 — see the frame helpers above.  Request ops:
+    ``P`` (pull: reply payload = f64 param bytes), ``U`` (push delta:
+    idempotent on ``req_id``), ``S`` (stats: u64 push count), ``Q``
+    (close).  A client dying mid-frame costs its own connection only
+    (counted in ``param_server_client_disconnects_total``); the server
+    and every other connection keep serving.
     """
+
+    #: remembered push req_ids for idempotent retries (per server, FIFO)
+    DEDUP_WINDOW = 4096
 
     def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
                  port: int = 0):
@@ -99,6 +149,8 @@ class TcpParameterServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        self._seen: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
         self._conns: List[socket.socket] = []
         self._threads: List[threading.Thread] = []
         self._accept = threading.Thread(target=self._accept_loop,
@@ -122,32 +174,63 @@ class TcpParameterServer:
                 self._conns = [c for c in self._conns if c.fileno() >= 0]
                 self._conns.append(conn)
 
+    def _push_once(self, req_id: int, delta: np.ndarray) -> None:
+        """Apply a push exactly once per ``req_id``: a retried frame
+        whose first attempt already landed is acked without re-applying
+        (the id is recorded AFTER the apply and BEFORE the ack, so a
+        crash between apply and ack is covered by the retry's dedup
+        lookup, never by double-application)."""
+        with self._lock:
+            if req_id in self._seen:
+                _monitor.counter(
+                    "param_server_duplicate_pushes_total",
+                    "retried pushes deduplicated by request id").inc()
+                return
+            # check+apply+mark under one lock: a retry racing its own
+            # first attempt on another handler thread must not
+            # double-apply
+            self.server.push(delta)
+            self._seen[req_id] = None
+            while len(self._seen) > self.DEDUP_WINDOW:
+                self._seen.popitem(last=False)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 while True:
-                    op = conn.recv(1)
-                    if not op or op == b"Q":
+                    frame = _read_frame(conn)
+                    if frame is None:
+                        return
+                    op, req_id, payload = frame
+                    if op == b"Q":
                         return
                     if op == b"P":
-                        data = self.server.pull().tobytes()
-                        conn.sendall(struct.pack(">Q", len(data)) + data)
+                        _send_response(conn, b"K",
+                                       self.server.pull().tobytes())
                     elif op == b"U":
-                        (n,) = struct.unpack(">Q", _recv_exact(conn, 8))
-                        delta = np.frombuffer(_recv_exact(conn, n),
-                                              np.float64)
+                        delta = np.frombuffer(payload, np.float64)
                         try:
-                            self.server.push(delta)
-                        except ValueError:
-                            conn.sendall(b"E")   # dimension mismatch
+                            self._push_once(req_id, delta)
+                        except ValueError as exc:
+                            _send_response(conn, b"E",
+                                           str(exc).encode("utf-8"))
                             continue
-                        conn.sendall(b"K")
+                        _send_response(conn, b"K")
                     elif op == b"S":
-                        conn.sendall(struct.pack(">Q", self.server.pushes))
+                        _send_response(conn, b"K", struct.pack(
+                            ">Q", self.server.pushes))
                     else:
+                        _send_response(conn, b"E",
+                                       f"unknown op {op!r}".encode())
                         return
         except (ConnectionError, OSError):
+            # a worker died mid-message (SIGKILL, network partition):
+            # its connection is torn down, the store and every other
+            # connection are untouched
+            _monitor.counter(
+                "param_server_client_disconnects_total",
+                "connections lost mid-message (worker death)").inc()
             return
 
     def close(self) -> None:
@@ -176,46 +259,119 @@ class TcpParameterServerClient:
     so :class:`ParameterServerParallelWrapper` workers use either
     transport interchangeably (reference ``ParameterServerClient``,
     ``ParameterServerParallelWrapper.java:215-216``).  One client per
-    worker thread; a socket is not shared."""
+    worker thread; a socket is not shared.
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._conn = socket.create_connection((host, port),
-                                              timeout=timeout)
-        self._conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    Hardened (wire v2): connections are lazy and re-established on
+    failure (bounded by ``max_retries``), requests retry with
+    exponential backoff + jitter, and pushes carry a stable ``req_id``
+    so a retry after a lost ack is deduplicated server-side instead of
+    double-applied.  ``E`` responses (semantic rejection, e.g. a
+    dimension mismatch) raise ``ValueError`` immediately — they are
+    deterministic and never retried."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 max_retries: int = 5, backoff_base: float = 0.05,
+                 backoff_max: float = 2.0):
+        self._address = (host, port)
+        self._timeout = float(timeout)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self._conn: Optional[socket.socket] = None
+        self._ever_connected = False
         self._lock = threading.Lock()
+        rng = random.Random()
+        self._jitter = rng.uniform
+        # unique-per-client id stream; the random base keeps ids from
+        # different clients (and client restarts) disjoint in the
+        # server's dedup window
+        self._req_ids = itertools.count(rng.getrandbits(64))
+
+    def _ensure_conn(self) -> socket.socket:
+        if self._conn is None:
+            self._conn = socket.create_connection(
+                self._address, timeout=self._timeout)
+            self._conn.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            if self._ever_connected:
+                _monitor.counter(
+                    "param_server_reconnects_total",
+                    "client TCP reconnects after a failure").inc()
+            self._ever_connected = True
+        return self._conn
+
+    def _drop_conn(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _request(self, op: bytes, payload: bytes, req_id: int) -> bytes:
+        """One framed request with bounded retry; caller holds the
+        lock.  Transport failures anywhere in the round trip tear the
+        socket down and retry the SAME frame (same ``req_id`` — the
+        server dedups pushes whose first attempt landed)."""
+        last: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                conn = self._ensure_conn()
+                _send_frame(conn, op, req_id, payload)
+                if op == b"U" and _faults.drop_connection():
+                    # fault point: the request is on the wire (the
+                    # server may apply it) but the ack never arrives
+                    self._drop_conn()
+                    raise ConnectionError(
+                        "fault-injected connection drop")
+                status, body = _read_response(conn)
+                if status == b"E":
+                    raise ValueError(body.decode("utf-8", "replace")
+                                     or "server rejected request")
+                if status != b"K":
+                    raise ConnectionError(
+                        f"bad response status {status!r}")
+                return body
+            except (ConnectionError, OSError) as exc:
+                last = exc
+                self._drop_conn()
+                if attempt >= self.max_retries:
+                    break
+                _monitor.counter(
+                    "param_server_retries_total",
+                    "request retries after transport failures").inc()
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2.0 ** attempt))
+                time.sleep(delay * self._jitter(0.5, 1.0))
+        raise ConnectionError(
+            f"parameter server at {self._address[0]}:{self._address[1]} "
+            f"unreachable after {self.max_retries + 1} attempts: "
+            f"{last}") from last
 
     def pull(self) -> np.ndarray:
         with self._lock:
-            self._conn.sendall(b"P")
-            (n,) = struct.unpack(">Q", _recv_exact(self._conn, 8))
-            return np.frombuffer(_recv_exact(self._conn, n),
-                                 np.float64).copy()
+            body = self._request(b"P", b"", next(self._req_ids))
+            return np.frombuffer(body, np.float64).copy()
 
     def push(self, delta: np.ndarray) -> None:
         data = np.asarray(delta, np.float64).tobytes()
         with self._lock:
-            self._conn.sendall(b"U" + struct.pack(">Q", len(data)) + data)
-            ack = _recv_exact(self._conn, 1)
-            if ack == b"E":
-                raise ValueError(
-                    "server rejected push: delta dimension does not "
-                    "match the store")
-            if ack != b"K":
-                raise ConnectionError("push not acknowledged")
+            self._request(b"U", data, next(self._req_ids))
 
     @property
     def pushes(self) -> int:
         with self._lock:
-            self._conn.sendall(b"S")
-            (n,) = struct.unpack(">Q", _recv_exact(self._conn, 8))
+            body = self._request(b"S", b"", next(self._req_ids))
+            (n,) = struct.unpack(">Q", body)
             return n
 
     def close(self) -> None:
-        try:
-            self._conn.sendall(b"Q")
-        except OSError:
-            pass
-        self._conn.close()
+        if self._conn is not None:
+            try:
+                _send_frame(self._conn, b"Q", 0)
+            except OSError:
+                pass
+            self._drop_conn()
 
     def __enter__(self) -> "TcpParameterServerClient":
         return self
@@ -289,6 +445,9 @@ class ParameterServerParallelWrapper:
             server = self._make_worker_client()
             i = 0
             while i < len(batches):
+                _faults.slow_worker()   # straggler fault point (no-op
+                #                         unless DL4J_TPU_FAULT_SLOW_
+                #                         WORKER_MS is armed)
                 start = server.pull()
                 replica.set_flat_params(start)
                 for _ in range(self.batches_per_push):

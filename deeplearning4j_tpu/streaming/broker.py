@@ -46,9 +46,15 @@ import uuid
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from .. import monitor as _monitor
 from .sources import RecordSource
 
 _MAGIC_LEN = struct.Struct(">I")
+
+#: default in-memory bound per (topic, partition); override per broker
+#: with ``max_records_per_partition=`` or fleet-wide with the env var
+DEFAULT_MAX_RECORDS = int(os.environ.get(
+    "DL4J_TPU_BROKER_MAX_RECORDS", "65536"))
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -89,10 +95,21 @@ class StreamBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  log_dir: Optional[str] = None,
-                 session_timeout: float = 10.0):
+                 session_timeout: float = 10.0,
+                 max_records_per_partition: Optional[int] = None):
         self._lock = threading.RLock()
         # (topic, partition) -> list of str records
         self._logs: Dict[Tuple[str, int], List[str]] = {}
+        # (topic, partition) -> logical offset of the first retained
+        # record: the in-memory log is a bounded WINDOW over the logical
+        # append stream.  Offsets stay monotonic; records older than the
+        # window are shed (load shedding — a slow consumer re-reads them
+        # from the persisted JSONL or takes the loss, it cannot OOM the
+        # broker for everyone else).
+        self._base: Dict[Tuple[str, int], int] = {}
+        self.max_records_per_partition = (
+            DEFAULT_MAX_RECORDS if max_records_per_partition is None
+            else int(max_records_per_partition))
         self._partitions: Dict[str, int] = {}
         self._rr: Dict[str, int] = {}          # producer round-robin cursor
         # group -> topic -> partition -> committed offset
@@ -137,6 +154,13 @@ class StreamBroker:
                 topic, _, part = stem.rpartition("-")
                 with open(os.path.join(self._log_dir, name)) as fh:
                     recs = [json.loads(line) for line in fh if line.strip()]
+                cap = self.max_records_per_partition
+                if cap and len(recs) > cap:
+                    # reload only the bounded tail window; offsets stay
+                    # logical (base = how much of the stream is on disk
+                    # only)
+                    self._base[(topic, int(part))] = len(recs) - cap
+                    recs = recs[-cap:]
                 self._logs[(topic, int(part))] = recs
                 self._partitions[topic] = max(
                     self._partitions.get(topic, 0), int(part) + 1)
@@ -199,24 +223,39 @@ class StreamBroker:
                 raise ValueError(f"partition {partition} out of range "
                                  f"(topic {topic!r} has {n})")
             log = self._logs[(topic, partition)]
-            base = len(log)
+            first = self._base.get((topic, partition), 0)
+            base = first + len(log)
             log.extend(records)
             self._persist_records(topic, partition, records)
+            cap = self.max_records_per_partition
+            if cap and len(log) > cap:
+                drop = len(log) - cap
+                del log[:drop]
+                self._base[(topic, partition)] = first + drop
+                _monitor.counter(
+                    "broker_records_dropped_total",
+                    "records shed from bounded partition windows").inc(
+                    drop, topic=topic)
             return partition, base
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_records: int = 256) -> Tuple[List[str], int, int]:
-        """Records from ``offset`` (repeatable — the log is immutable);
+        """Records from logical ``offset`` (repeatable within the
+        retained window; an offset already shed from the bounded
+        in-memory log is clamped forward to the window start);
         returns (records, next_offset, end_offset)."""
         with self._lock:
             log = self._logs.get((topic, partition), [])
-            out = log[offset:offset + max_records]
-            return out, offset + len(out), len(log)
+            first = self._base.get((topic, partition), 0)
+            start = max(int(offset), first)
+            out = log[start - first:start - first + max_records]
+            return out, start + len(out), first + len(log)
 
     def end_offsets(self, topic: str) -> Dict[int, int]:
         with self._lock:
             n = self._ensure_topic(topic)
-            return {p: len(self._logs.get((topic, p), []))
+            return {p: self._base.get((topic, p), 0)
+                    + len(self._logs.get((topic, p), []))
                     for p in range(n)}
 
     # ---- committed offsets ----------------------------------------------

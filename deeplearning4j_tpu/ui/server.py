@@ -460,6 +460,12 @@ class UIServer:
             device_count = jax.device_count()
         except Exception:
             pass
+        checkpoint = None
+        try:
+            from ..resilience import checkpoint as _ckpt
+            checkpoint = _ckpt.status()
+        except Exception:
+            pass
         return {
             "status": "ok",
             "backend": backend,
@@ -467,6 +473,7 @@ class UIServer:
             "last_dispatch_timestamp":
                 _mon.health.last_dispatch_timestamp(),
             "health": _mon.health.state(),
+            "checkpoint": checkpoint,
         }
 
     def health_data(self) -> dict:

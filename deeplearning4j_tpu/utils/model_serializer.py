@@ -15,6 +15,7 @@ serialized INDArray; raw f32 keeps it dependency-free and judge-inspectable).
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import zipfile
@@ -29,30 +30,48 @@ STATE_BIN = "state.bin"
 MANIFEST_JSON = "manifest.json"
 
 
+class ModelSerializationError(ValueError):
+    """A model zip failed validation: truncated/oversized coefficient or
+    updater payloads, a size/shape mismatch against the target network,
+    a digest mismatch against the manifest, or a corrupt container."""
+
+
+def _entry_digests(payload) -> dict:
+    """Per-entry integrity records for the manifest: name -> sha256+size."""
+    return {name: {"sha256": hashlib.sha256(data).hexdigest(),
+                   "size": len(data)}
+            for name, data in payload}
+
+
 def write_model(net, path: str, save_updater: bool = True) -> None:
     """Reference ``ModelSerializer.writeModel(model, file, saveUpdater)``."""
     net.init()
     flat = net.get_flat_params().astype("<f4")
     state_flat, state_manifest = _flatten_state(net)
+    payload = [(CONFIG_JSON, net.conf.to_json().encode("utf-8")),
+               (COEFFICIENTS_BIN, flat.tobytes())]
+    ustate = net.get_flat_updater_state().astype("<f4") if save_updater \
+        else np.zeros((0,), "<f4")
+    if save_updater:
+        payload.append((UPDATER_BIN, ustate.tobytes()))
+    if state_flat.size:
+        payload.append((STATE_BIN, state_flat.astype("<f4").tobytes()))
     manifest = {
         "framework": "deeplearning4j_tpu",
         "model_class": type(net).__name__,
         "num_params": int(flat.size),
+        "num_updater_values": int(ustate.size),
         "iteration": int(getattr(net, "iteration", 0)),
         "epoch": int(getattr(net, "epoch", 0)),
         # without this, restoring a pretrain=True model and calling fit()
         # would re-run unsupervised pretraining over the fine-tuned weights
         "pretrain_done": bool(getattr(net, "_pretrain_done", False)),
         "state": state_manifest,
+        "entries": _entry_digests(payload),
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(CONFIG_JSON, net.conf.to_json())
-        zf.writestr(COEFFICIENTS_BIN, flat.tobytes())
-        if save_updater:
-            zf.writestr(UPDATER_BIN,
-                        net.get_flat_updater_state().astype("<f4").tobytes())
-        if state_flat.size:
-            zf.writestr(STATE_BIN, state_flat.astype("<f4").tobytes())
+        for name, data in payload:
+            zf.writestr(name, data)
         zf.writestr(MANIFEST_JSON, json.dumps(manifest, indent=2))
 
 
@@ -61,7 +80,7 @@ def restore_multi_layer_network(path: str, load_updater: bool = True):
     from ..nn.conf.neural_net_configuration import MultiLayerConfiguration
     from ..nn.multilayer import MultiLayerNetwork
 
-    with zipfile.ZipFile(path, "r") as zf:
+    with _open_model_zip(path) as zf:
         conf = MultiLayerConfiguration.from_json(
             zf.read(CONFIG_JSON).decode("utf-8"))
         net = MultiLayerNetwork(conf).init()
@@ -74,7 +93,7 @@ def restore_computation_graph(path: str, load_updater: bool = True):
     from ..nn.conf.computation_graph import ComputationGraphConfiguration
     from ..nn.computation_graph import ComputationGraph
 
-    with zipfile.ZipFile(path, "r") as zf:
+    with _open_model_zip(path) as zf:
         conf = ComputationGraphConfiguration.from_json(
             zf.read(CONFIG_JSON).decode("utf-8"))
         net = ComputationGraph(conf).init()
@@ -82,22 +101,86 @@ def restore_computation_graph(path: str, load_updater: bool = True):
     return net
 
 
+def _open_model_zip(path: str) -> zipfile.ZipFile:
+    try:
+        return zipfile.ZipFile(path, "r")
+    except zipfile.BadZipFile as exc:
+        raise ModelSerializationError(
+            f"{path} is not a valid model zip: {exc}") from exc
+
+
+def _read_entry(zf: zipfile.ZipFile, name: str, entries) -> bytes:
+    """Read one zip entry, verifying size+sha256 against the manifest's
+    ``entries`` record when present (older zips have none — skip)."""
+    try:
+        data = zf.read(name)
+    except zipfile.BadZipFile as exc:
+        raise ModelSerializationError(
+            f"model entry {name!r} is corrupt: {exc}") from exc
+    rec = (entries or {}).get(name)
+    if rec is not None:
+        if len(data) != int(rec["size"]):
+            raise ModelSerializationError(
+                f"model entry {name!r} is {len(data)} bytes; manifest "
+                f"records {rec['size']}")
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != rec["sha256"]:
+            raise ModelSerializationError(
+                f"model entry {name!r} sha256 mismatch: manifest "
+                f"{rec['sha256'][:12]}..., payload {digest[:12]}...")
+    return data
+
+
 def _restore_into(net, zf: zipfile.ZipFile, load_updater: bool) -> None:
     names = set(zf.namelist())
-    flat = np.frombuffer(zf.read(COEFFICIENTS_BIN), "<f4")
+    manifest = json.loads(_read_entry(zf, MANIFEST_JSON, None)) \
+        if MANIFEST_JSON in names else {}
+    entries = manifest.get("entries")
+    raw = _read_entry(zf, COEFFICIENTS_BIN, entries)
+    if len(raw) % 4:
+        raise ModelSerializationError(
+            f"{COEFFICIENTS_BIN} is {len(raw)} bytes — not a whole number "
+            "of float32 values; file is truncated or corrupt")
+    flat = np.frombuffer(raw, "<f4")
+    want = manifest.get("num_params")
+    if want is not None and flat.size != int(want):
+        raise ModelSerializationError(
+            f"{COEFFICIENTS_BIN} holds {flat.size} parameters; manifest "
+            f"records {want}")
+    have = int(net.num_params())
+    if flat.size != have:
+        raise ModelSerializationError(
+            f"model file holds {flat.size} parameters but the target "
+            f"{type(net).__name__} has {have}; architectures differ")
     net.set_flat_params(flat)
     if load_updater and UPDATER_BIN in names:
-        ustate = np.frombuffer(zf.read(UPDATER_BIN), "<f4")
+        uraw = _read_entry(zf, UPDATER_BIN, entries)
+        if len(uraw) % 4:
+            raise ModelSerializationError(
+                f"{UPDATER_BIN} is {len(uraw)} bytes — not a whole number "
+                "of float32 values; file is truncated or corrupt")
+        ustate = np.frombuffer(uraw, "<f4")
+        uwant = manifest.get("num_updater_values")
+        if uwant is not None and ustate.size != int(uwant):
+            raise ModelSerializationError(
+                f"{UPDATER_BIN} holds {ustate.size} values; manifest "
+                f"records {uwant}")
         if ustate.size:
             net.set_flat_updater_state(ustate)
-    if MANIFEST_JSON in names:
-        manifest = json.loads(zf.read(MANIFEST_JSON))
+    if manifest:
         net.iteration = manifest.get("iteration", 0)
         net.epoch = manifest.get("epoch", 0)
         net._pretrain_done = manifest.get("pretrain_done", False)
         if STATE_BIN in names and manifest.get("state"):
-            _unflatten_state(net, np.frombuffer(zf.read(STATE_BIN), "<f4"),
-                             manifest["state"])
+            sflat = np.frombuffer(_read_entry(zf, STATE_BIN, entries), "<f4")
+            smax = max((int(e["offset"])
+                        + (int(np.prod(e["shape"])) if e["shape"] else 1)
+                        for e in manifest["state"]), default=0)
+            if smax > sflat.size:
+                raise ModelSerializationError(
+                    f"{STATE_BIN} holds {sflat.size} values but the state "
+                    f"manifest addresses up to {smax}; file is truncated")
+            _unflatten_state(net, sflat, manifest["state"])
 
 
 def _flatten_state(net):

@@ -1,0 +1,437 @@
+"""Fault-tolerant training runtime tests (docs/RESILIENCE.md).
+
+The acceptance bars this file automates:
+
+- kill-and-resume parity: a training subprocess SIGKILLed mid-epoch and
+  resumed from its last checkpoint produces a loss trajectory and final
+  params bit-identical (fp32) to an uninterrupted run;
+- corrupted checkpoints are rejected with a diagnostic and ``latest()``
+  falls back to the newest checkpoint that verifies;
+- a param-server worker killed mid-push costs only its own connection
+  (the server and other workers keep going), and retried pushes are
+  idempotent under fault-injected connection drops;
+- the stream broker sheds load instead of growing partition logs
+  without bound;
+- model serialization validates sizes/digests instead of loading
+  garbage, and the early-stopping file saver is interrupt-atomic.
+"""
+
+import json
+import os
+import socket
+import struct
+import time
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.resilience import chaos, faults
+from deeplearning4j_tpu.resilience.checkpoint import (
+    CheckpointCorruptError, CheckpointManager, list_checkpoints, restore,
+    verify_checkpoint)
+from deeplearning4j_tpu.resilience import checkpoint as ckpt_mod
+from deeplearning4j_tpu.utils.model_serializer import (
+    ModelSerializationError, restore_multi_layer_network, write_model)
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    monitor.reset()
+    faults.configure()           # disarm everything
+    ckpt_mod._reset_status()
+    yield
+    monitor.reset()
+    faults.reset()               # back to (clean) env
+    ckpt_mod._reset_status()
+
+
+def _params_sha(net):
+    return chaos._params_sha256(net)
+
+
+# ------------------------------------------------ checkpoint mechanics
+
+def test_checkpoint_write_verify_restore_roundtrip(tmp_path):
+    net = chaos.build_net()
+    net.fit(chaos.build_iterator(), epochs=1)
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    path = mgr.save(net, step_in_epoch=0)
+    assert os.path.exists(path)
+    # no temp droppings next to the durable file
+    assert [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")] == []
+    manifest = verify_checkpoint(path)
+    assert manifest["num_params"] == net.num_params()
+    assert set(manifest["entries"]) >= {"configuration.json",
+                                        "coefficients.bin",
+                                        "updaterState.bin", "resume.json"}
+    for ent in manifest["entries"].values():
+        assert set(ent) == {"sha256", "size"}
+
+    net2 = chaos.build_net()
+    rs = restore(net2, path)
+    assert rs.iteration == net.iteration
+    assert rs.epoch == net.epoch
+    assert _params_sha(net2) == _params_sha(net)
+    # a checkpoint is a superset of the model_serializer format
+    net3 = restore_multi_layer_network(path)
+    assert _params_sha(net3) == _params_sha(net)
+    assert monitor.counter(ckpt_mod.WRITES_TOTAL).value() == 1
+    assert monitor.counter(ckpt_mod.RESTORES_TOTAL).value() == 1
+
+
+def test_checkpoint_retention_keep_last(tmp_path):
+    net = chaos.build_net()
+    mgr = CheckpointManager(str(tmp_path), keep_last=2, async_write=False)
+    for _ in range(5):
+        net.fit(chaos.build_iterator(), epochs=1)
+        mgr.save(net)
+    kept = list_checkpoints(str(tmp_path))
+    assert len(kept) == 2
+    # newest first, highest iterations retained
+    its = [int(os.path.basename(p)[len("checkpoint-"):-len(".zip")])
+           for p in kept]
+    assert its == sorted(its, reverse=True)
+    assert its[0] == net.iteration
+    assert monitor.counter(ckpt_mod.PRUNED_TOTAL).value() == 3
+
+
+def test_corrupt_checkpoint_rejected_with_diagnostic(tmp_path):
+    net = chaos.build_net()
+    net.fit(chaos.build_iterator(), epochs=1)
+    mgr = CheckpointManager(str(tmp_path), keep_last=4, async_write=False)
+    good = mgr.save(net)
+    net.fit(chaos.build_iterator(), epochs=1)
+    bad = mgr.save(net)
+    faults.corrupt_file(bad)
+
+    with pytest.raises(CheckpointCorruptError) as ei:
+        verify_checkpoint(bad)
+    msg = str(ei.value)
+    assert bad in msg            # diagnostic names the file
+    with pytest.raises(CheckpointCorruptError):
+        restore(chaos.build_net(), bad)
+    # latest() skips the torn write and recovers from the one before
+    assert mgr.latest() == good
+    assert monitor.counter(ckpt_mod.CORRUPT_SKIPPED).value() >= 1
+
+
+def test_corrupt_checkpoint_fault_injection(tmp_path):
+    """The DL4J_TPU_FAULT_CORRUPT_CHECKPOINT path: the writer corrupts
+    its own finalized file, and discovery must refuse it."""
+    net = chaos.build_net()
+    net.fit(chaos.build_iterator(), epochs=1)
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    faults.configure(corrupt_checkpoint=1)
+    path = mgr.save(net)
+    assert mgr.latest() is None      # the only checkpoint is corrupt
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+    assert monitor.counter(
+        faults.INJECTIONS_TOTAL).value(point="corrupt_checkpoint") == 1
+
+
+def test_resume_semantics_total_epoch_target(tmp_path):
+    """epochs is the TOTAL target when resuming: restoring an
+    epoch-3-complete checkpoint with epochs=3 trains nothing more."""
+    net = chaos.build_net()
+    net.fit(chaos.build_iterator(), epochs=3,
+            checkpoint=CheckpointManager(str(tmp_path), async_write=False))
+    done_sha = _params_sha(net)
+
+    net2 = chaos.build_net()
+    net2.fit(chaos.build_iterator(), epochs=3,
+             resume_from=str(tmp_path))
+    assert net2.iteration == net.iteration
+    assert _params_sha(net2) == done_sha
+
+
+def test_mid_epoch_resume_bit_identical(tmp_path):
+    """The tentpole invariant, in-process: resume from a MID-EPOCH
+    checkpoint (step cadence not aligned to the epoch) reproduces the
+    uninterrupted run's final params bit-for-bit on the fused-scan
+    path."""
+    ref = chaos.build_net()
+    ref.fit(chaos.build_iterator(), epochs=3)
+
+    net = chaos.build_net()
+    mgr = CheckpointManager(str(tmp_path / "ck"), every_steps=3,
+                            keep_last=8)
+    net.fit(chaos.build_iterator(), epochs=3, checkpoint=mgr)
+    assert _params_sha(net) == _params_sha(ref)   # cadence is inert
+
+    cks = list_checkpoints(str(tmp_path / "ck"))
+    # pick a genuinely mid-epoch checkpoint (8 steps/epoch, cadence 3)
+    mid = [p for p in cks
+           if int(os.path.basename(p)[11:-4]) % 8 not in (0,)][0]
+    with zipfile.ZipFile(mid) as zf:
+        resume = json.loads(zf.read("resume.json"))
+    assert resume["step_in_epoch"] > 0
+
+    net2 = chaos.build_net()
+    net2.fit(chaos.build_iterator(), epochs=3, resume_from=mid)
+    assert net2.iteration == ref.iteration
+    assert _params_sha(net2) == _params_sha(ref)
+
+
+def test_partial_epoch_restart_warns_on_batch_path(tmp_path):
+    net = chaos.build_net()
+    mgr = CheckpointManager(str(tmp_path), every_steps=3, keep_last=8,
+                            async_write=False)
+    net.fit(chaos.build_iterator(), epochs=2, checkpoint=mgr)
+    mid = [p for p in list_checkpoints(str(tmp_path))
+           if json.loads(zipfile.ZipFile(p).read("resume.json"))
+           ["step_in_epoch"] > 0][0]
+    net2 = chaos.build_net()
+    with pytest.warns(RuntimeWarning, match="mid-epoch"):
+        net2.fit(chaos.build_iterator(), epochs=2, ingest="batch",
+                 resume_from=mid)
+    assert net2.epoch == 2
+
+
+def test_checkpoint_status_and_healthz(tmp_path):
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    net = chaos.build_net()
+    net.fit(chaos.build_iterator(), epochs=1,
+            checkpoint=CheckpointManager(str(tmp_path), async_write=False))
+    st = ckpt_mod.status()
+    assert st is not None and st["iteration"] == net.iteration
+    assert st["age_seconds"] >= 0
+
+    server = UIServer(port=0).start()
+    try:
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz").read())
+        assert hz["checkpoint"]["iteration"] == net.iteration
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics").read().decode()
+        assert "checkpoint_writes_total" in body
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------ kill/resume (subprocess)
+
+def test_chaos_kill_resume_parity(tmp_path):
+    """ROADMAP item 1's acceptance bar, end to end: SIGKILL a real
+    training process mid-epoch (fault injection via the environment),
+    resume it, and require bitwise loss-curve + final-param parity with
+    an uninterrupted run."""
+    report = chaos.run_chaos(workdir=str(tmp_path))
+    assert report["victim_killed"], report
+    assert report["victim_returncode"] == -9, report
+    assert report["coverage_ok"], report
+    assert report["score_mismatches"] == 0, report
+    assert report["params_match"], report
+    assert report["parity"], report
+
+
+# ------------------------------------------------ serializer validation
+
+def test_serializer_rejects_truncated_coefficients(tmp_path):
+    net = chaos.build_net()
+    path = str(tmp_path / "model.bin")
+    write_model(net, path)
+    # rebuild the zip with a truncated coefficients entry
+    trunc = str(tmp_path / "trunc.bin")
+    with zipfile.ZipFile(path) as zin, \
+            zipfile.ZipFile(trunc, "w") as zout:
+        for name in zin.namelist():
+            data = zin.read(name)
+            if name == "coefficients.bin":
+                data = data[:-8]
+            zout.writestr(name, data)
+    with pytest.raises(ModelSerializationError) as ei:
+        restore_multi_layer_network(trunc)
+    assert "coefficients.bin" in str(ei.value)
+
+
+def test_serializer_rejects_wrong_architecture(tmp_path):
+    net = chaos.build_net()
+    path = str(tmp_path / "model.bin")
+    write_model(net, path)
+    other = chaos.build_net(n_in=9)          # different param count
+    with zipfile.ZipFile(path) as zf:
+        from deeplearning4j_tpu.utils.model_serializer import _restore_into
+        with pytest.raises(ModelSerializationError, match="parameters"):
+            _restore_into(other, zf, load_updater=True)
+
+
+def test_serializer_rejects_non_zip(tmp_path):
+    path = str(tmp_path / "junk.bin")
+    with open(path, "wb") as fh:
+        fh.write(b"this is not a zip file")
+    with pytest.raises(ModelSerializationError):
+        restore_multi_layer_network(path)
+
+
+def test_local_file_saver_interrupt_leaves_old_model(tmp_path,
+                                                    monkeypatch):
+    """Regression: a crash mid-save must never tear bestModel.bin —
+    the previous valid model must survive."""
+    from deeplearning4j_tpu.earlystopping import savers as savers_mod
+
+    net = chaos.build_net()
+    saver = savers_mod.LocalFileModelSaver(str(tmp_path))
+    saver.save_best_model(net, 0.5)
+    final = os.path.join(str(tmp_path), "bestModel.bin")
+    before = open(final, "rb").read()
+
+    def _boom(net_, path, save_updater=True):
+        with open(path, "wb") as fh:
+            fh.write(b"half a zi")       # torn partial write
+        raise KeyboardInterrupt("interrupted mid-serialization")
+
+    import deeplearning4j_tpu.utils.model_serializer as ms
+    monkeypatch.setattr(ms, "write_model", _boom)
+    with pytest.raises(KeyboardInterrupt):
+        saver.save_best_model(net, 0.1)
+    assert open(final, "rb").read() == before     # untouched
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith(".tmp-")] == []       # temp cleaned up
+    restored = saver.get_best_model()
+    assert _params_sha(restored) == _params_sha(net)
+
+
+# ------------------------------------------------ hardened scaleout wire
+
+def _mk_server(dim=8):
+    from deeplearning4j_tpu.scaleout.param_server import (
+        ParameterServer, TcpParameterServer)
+    store = ParameterServer(np.zeros(dim))
+    return store, TcpParameterServer(store)
+
+
+def test_param_server_survives_worker_killed_mid_push():
+    """A worker dying with half a frame on the wire costs its own
+    connection only: the server keeps serving every other client, and
+    the death is counted."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        TcpParameterServerClient)
+
+    store, srv = _mk_server(dim=8)
+    try:
+        # half a push frame: header promises 64 payload bytes, send 10,
+        # then die (socket closed abruptly — the SIGKILL wire signature)
+        raw = socket.create_connection((srv.host, srv.port))
+        raw.sendall(b"U" + struct.pack(">QQ", 12345, 64) + b"x" * 10)
+        raw.close()
+
+        with TcpParameterServerClient(srv.host, srv.port) as c:
+            c.push(np.ones(8))
+            assert c.pushes == 1
+            np.testing.assert_array_equal(c.pull(), np.ones(8))
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if monitor.counter(
+                    "param_server_client_disconnects_total").value() >= 1:
+                break
+            time.sleep(0.02)
+        assert monitor.counter(
+            "param_server_client_disconnects_total").value() >= 1
+        assert store.pushes == 1          # the torn push never applied
+    finally:
+        srv.close()
+
+
+def test_param_server_push_idempotent_under_drop_fault():
+    """drop_connection severs the socket after the push frame is sent
+    but before the ack: the client must retry with the SAME request id
+    and the server must apply the delta exactly once."""
+    from deeplearning4j_tpu.scaleout.param_server import (
+        TcpParameterServerClient)
+
+    store, srv = _mk_server(dim=4)
+    try:
+        faults.configure(drop_connection=1)
+        with TcpParameterServerClient(srv.host, srv.port) as c:
+            c.push(np.full(4, 2.0))
+        assert store.pushes == 1                      # not double-applied
+        np.testing.assert_array_equal(store.pull(), np.full(4, 2.0))
+        assert monitor.counter(
+            "param_server_retries_total").value() >= 1
+        assert monitor.counter(
+            "param_server_reconnects_total").value() >= 1
+        assert monitor.counter(
+            "param_server_duplicate_pushes_total").value() == 1
+        assert monitor.counter(
+            faults.INJECTIONS_TOTAL).value(point="drop_connection") == 1
+    finally:
+        srv.close()
+
+
+def test_param_server_dimension_mismatch_not_retried():
+    from deeplearning4j_tpu.scaleout.param_server import (
+        TcpParameterServerClient)
+
+    store, srv = _mk_server(dim=4)
+    try:
+        with TcpParameterServerClient(srv.host, srv.port) as c:
+            with pytest.raises(ValueError, match="shape"):
+                c.push(np.ones(7))
+        assert store.pushes == 0
+        assert monitor.counter("param_server_retries_total").value() == 0
+    finally:
+        srv.close()
+
+
+def test_param_server_client_bounded_retries_then_raises():
+    from deeplearning4j_tpu.scaleout.param_server import (
+        TcpParameterServerClient)
+
+    # a port with nothing listening: connect is refused every attempt
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    c = TcpParameterServerClient("127.0.0.1", port, max_retries=2,
+                                 backoff_base=0.01)
+    t0 = time.time()
+    with pytest.raises(ConnectionError, match="after 3 attempts"):
+        c.pull()
+    assert time.time() - t0 < 10.0
+    assert monitor.counter("param_server_retries_total").value() == 2
+
+
+# ------------------------------------------------ broker load shedding
+
+def test_broker_sheds_oldest_records_and_keeps_offsets_logical():
+    from deeplearning4j_tpu.streaming.broker import StreamBroker
+
+    broker = StreamBroker(max_records_per_partition=10)
+    try:
+        broker.create_topic("t", 1)
+        for i in range(25):
+            broker.produce("t", [f"r{i}"], partition=0)
+        assert broker.end_offsets("t") == {0: 25}     # logical, monotonic
+        recs, nxt, end = broker.fetch("t", 0, 0, max_records=100)
+        assert recs == [f"r{i}" for i in range(15, 25)]   # oldest shed
+        assert (nxt, end) == (25, 25)
+        # an in-window offset is still served exactly
+        recs, nxt, _ = broker.fetch("t", 0, 20, max_records=2)
+        assert recs == ["r20", "r21"] and nxt == 22
+        assert monitor.counter(
+            "broker_records_dropped_total").value(topic="t") == 15
+    finally:
+        broker.close()
+
+
+# ------------------------------------------------ fault configuration
+
+def test_faults_env_parsing(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FAULT_DIE_AT_STEP", "17")
+    monkeypatch.setenv("DL4J_TPU_FAULT_CORRUPT_CHECKPOINT", "2")
+    monkeypatch.setenv("DL4J_TPU_FAULT_DROP_CONNECTION", "1")
+    monkeypatch.setenv("DL4J_TPU_FAULT_SLOW_WORKER_MS", "1.5")
+    faults.reset()
+    assert faults.spec() == {"die_at_step": 17, "corrupt_checkpoint": 2,
+                             "drop_connection": 1, "slow_worker_ms": 1.5}
+    assert faults.corrupt_checkpoint() is True
+    assert faults.corrupt_checkpoint() is True
+    assert faults.corrupt_checkpoint() is False      # tokens consumed
+    t0 = time.perf_counter()
+    faults.slow_worker()
+    assert time.perf_counter() - t0 >= 0.001

@@ -175,7 +175,7 @@ def compiled_step(config: str):
         steps = n // batch
         args = (net.params, net.updater_state, net.net_state,
                 net.iteration, f, l, net._rng_key, shuffle_key, 0, 1,
-                steps, batch, True, 0, (255.0, 1.0, 0.0))
+                steps, batch, True, 0, (255.0, 1.0, 0.0), 0, steps)
         return net._gather_train_step.lower(*args).compile()
     elif config in ("glove", "glove-naive"):
         # scatter-row audit for the embedding economics work: compile a
