@@ -39,9 +39,10 @@ BASELINE_SAMPLES_PER_SEC = 1488.0
 
 
 def _bf16_if_tpu():
-    import jax
-    return "bfloat16" if any(d.platform == "tpu"
-                             for d in jax.devices()) else None
+    # deduplicated: the precision module owns the backend-default compute
+    # dtype (and the DL4J_TPU_PRECISION override) — docs/PERFORMANCE.md
+    from deeplearning4j_tpu.nn.precision import default_compute_dtype
+    return default_compute_dtype()
 
 
 def _measured(fn, trials: int) -> dict:
@@ -288,8 +289,7 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
     # gather, so the staged (steps, B, ...) stack is bf16 (~1.3 GB at
     # 3200 steps) rather than f32 (~2.6 GB) — same policy as the
     # ResNet bench's staging
-    in_dtype = (jnp.bfloat16 if conf.conf.compute_dtype == "bfloat16"
-                else jnp.float32)
+    in_dtype = jnp.dtype(net._pol().compute_dtype)
     f_dev = jnp.asarray(np.stack(
         [features[i * batch:(i + 1) * batch]
          for i in range(n)])).astype(in_dtype)
@@ -316,6 +316,7 @@ def bench_lenet(batch: int = 256, steps: int = 3200, trials: int = 3,
         "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
         "batch": batch,
         "step_device_ms": round(device_ms, 4),
+        "precision": net._pol().describe(),
     }
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
@@ -343,7 +344,7 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
     snap = monitor.snapshot()
     t_data = time.perf_counter()
     rng = np.random.RandomState(0)
-    in_dtype = np.dtype("float32") if bf16 is None else jnp.bfloat16
+    in_dtype = jnp.dtype(net._pol().compute_dtype)
     f = rng.rand(batch, 224, 224, 3).astype(np.float32)
     l = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
     # stage (steps, B, ...) on-device once: cast on host batch, broadcast
@@ -362,7 +363,8 @@ def bench_resnet50(batch: int = 128, steps: int = 8, trials: int = 3,
     result = {"metric": "resnet50_imagenet_train_samples_per_sec_per_chip",
               "value": round(sps, 1), "unit": "samples/sec/chip",
               "vs_baseline": None, "batch": batch,
-              "step_device_ms": round(device_ms, 4)}
+              "step_device_ms": round(device_ms, 4),
+              "precision": net._pol().describe()}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     result.update(_phase_fields(snap))
@@ -417,7 +419,8 @@ def bench_lstm(batch: int = 32, seq: int = 64, vocab: int = 84,
     result = {"metric": "graves_lstm_charnn_chars_per_sec_per_chip",
               "value": round(chars, 1), "unit": "chars/sec/chip",
               "vs_baseline": None, "batch": batch, "seq": seq,
-              "step_device_ms": round(device_ms, 4)}
+              "step_device_ms": round(device_ms, 4),
+              "precision": net._pol().describe()}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     result.update(_phase_fields(snap))
@@ -443,7 +446,7 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
     snap = monitor.snapshot()
     t_data = time.perf_counter()
     rng = np.random.RandomState(0)
-    in_dtype = np.dtype("float32") if bf16 is None else jnp.bfloat16
+    in_dtype = jnp.dtype(net._pol().compute_dtype)
     f = rng.rand(batch, 224, 224, 3).astype(np.float32)
     l = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
     # on-chip scan loop + cast-then-broadcast staging; see bench_resnet50
@@ -460,7 +463,8 @@ def bench_vgg16(batch: int = 256, steps: int = 4, trials: int = 3,
     result = {"metric": "vgg16_import_train_samples_per_sec_per_chip",
               "value": round(sps, 1), "unit": "samples/sec/chip",
               "vs_baseline": None, "batch": batch,
-              "step_device_ms": round(device_ms, 4)}
+              "step_device_ms": round(device_ms, 4),
+              "precision": net._pol().describe()}
     result.update(_band_fields(meas, work, trials))
     result.update(_roofline_fields(cost, pipeline * steps / meas["median"]))
     result.update(_phase_fields(snap))
@@ -825,18 +829,39 @@ def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
                           trials: int = 3) -> dict:
     """Pallas flash attention fwd+fused-bwd throughput at a sequence
     length the XLA attention path cannot compile (linear-memory
-    long-context tier; see BASELINE.md)."""
+    long-context tier; see BASELINE.md).  Inputs follow the precision
+    policy's compute dtype (the kernel accumulates f32 regardless)."""
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.nn.precision import default_compute_dtype
     from deeplearning4j_tpu.ops.attention import flash_attention
 
+    in_dtype = (jnp.bfloat16 if default_compute_dtype() == "bfloat16"
+                else jnp.float32)
     rng = np.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.randn(batch, seq, heads, d_head)
-                           .astype(np.float32)) for _ in range(3))
+                           .astype(np.float32)).astype(in_dtype)
+               for _ in range(3))
     lossg = jax.jit(jax.value_and_grad(
-        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True) ** 2),
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)
+            ** 2),
         argnums=(0, 1, 2)))
+    # hand roofline for the flash step (the cost model cannot see inside
+    # the Pallas custom call): with N = B*S*H*D streamed at the input
+    # width, fwd reads q/k/v + writes o (4N) plus the f32 per-row
+    # logsumexp; the fused 2-pass bwd reads q/k/v/do twice (8N), writes
+    # dq/dk/dv (3N), and the delta pre-pass reads do/o (2N) — 17N total
+    # plus 3 f32 row-stat streams.  FLOPs: 2 matmuls fwd + 5 bwd over
+    # the S^2 score tiles, halved by causal masking.
+    n_elems = batch * seq * heads * d_head
+    isz = jnp.dtype(in_dtype).itemsize
+    hand_bytes = 17 * n_elems * isz + 3 * batch * heads * seq * 4
+    hand_flops = 0.5 * 14 * batch * heads * seq * seq * d_head
+    cost = _compiled_cost(lossg.lower(q, k, v).compile())
+    cost = {"flops": cost.get("flops") or hand_flops,
+            "bytes": float(hand_bytes), "bytes_xla": cost.get("bytes")}
     loss, grads = lossg(q, k, v)
     float(loss)                 # fetch = the reliable completion barrier
 
@@ -862,8 +887,10 @@ def bench_flash_attention(batch: int = 2, seq: int = 8192, heads: int = 4,
     result = {"metric": "flash_attention_train_tokens_per_sec_per_chip",
               "value": round(tokens, 1), "unit": "tokens/sec/chip",
               "vs_baseline": None, "batch": batch, "seq": seq,
-              "step_device_ms": round(device_ms, 4)}
+              "step_device_ms": round(device_ms, 4),
+              "precision": jnp.dtype(in_dtype).name}
     result.update(_band_fields(meas, work, trials))
+    result.update(_roofline_fields(cost, steps / meas["median"]))
     return result
 
 
@@ -1164,6 +1191,76 @@ def bench_scaling() -> dict:
             "detail": rep, "vs_baseline": None}
 
 
+def _smoke_precision_fields(batch: int = 32) -> dict:
+    """Precision-campaign fields for the CI perf-smoke line: the fp32
+    twin's cost-model bytes, the chip-posture estimate under the
+    resolved policy, and the deterministic autotuner decision for the
+    smoke ladder.  The estimate re-costs the fp32 program's f32 traffic
+    at policy widths (tools/hbm_profile.py owns the model) because
+    CPU-XLA upcasts bf16 conv/dot through convert fusions and would
+    OVERSTATE the bf16 program's bytes."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.nn import precision
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from tools import autotune as _autotune
+    from tools import hbm_profile as _hp
+
+    pol = MultiLayerNetwork(lenet()).init()._pol()
+    prev = os.environ.get(precision._ENV)
+    os.environ[precision._ENV] = precision.FP32
+    try:
+        net32 = MultiLayerNetwork(lenet()).init()
+    finally:
+        if prev is None:
+            os.environ.pop(precision._ENV, None)
+        else:
+            os.environ[precision._ENV] = prev
+    f = jnp.zeros((1, batch, 784), jnp.float32)
+    l = jnp.zeros((1, batch, 10), jnp.float32)
+    compiled32 = net32._multi_train_step.lower(
+        net32.params, net32.updater_state, net32.net_state,
+        net32.iteration, f, l, None, None, net32._rng_key).compile()
+    cost32 = _compiled_cost(compiled32).get("bytes") or 0.0
+    _, total32, by_dtype32 = _hp.profile_hlo(compiled32.as_text())
+    moments_io = 2 * sum(int(a.size) * a.dtype.itemsize
+                         for a in jax.tree.leaves(net32.updater_state))
+    master_io = 2 * 4 * sum(int(a.size)
+                            for a in jax.tree.leaves(net32.params))
+    est = _hp.chip_posture_estimate(total32, by_dtype32.get("f32", 0),
+                                    moments_io, master_io,
+                                    pol.master_weights)
+    est_cost = cost32 * (est / total32) if total32 else cost32
+    if pol.name == precision.FP32:
+        est_cost = cost32
+    fields = {"precision": pol.describe(),
+              "xla_cost_bytes_fp32": round(cost32, 1),
+              "hbm_bytes_chip_estimate": round(est_cost, 1),
+              "bytes_dropped": bool(est_cost < cost32)}
+    d = _autotune.autotune("lenet", deterministic=True, use_cache=False,
+                           smoke=True)
+    fields["autotune"] = {"signature": d["signature"],
+                          "batch": d["batch"],
+                          "steps_per_dispatch": d["steps_per_dispatch"],
+                          "bytes_per_sample": d["bytes_per_sample"]}
+    try:
+        base_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools",
+            "perf_baseline.json")
+        with open(base_path) as fh:
+            ref = json.load(fh)["lenet_smoke"]["xla_cost_bytes_fp32"]
+        fields["fp32_baseline_bytes"] = ref
+        fields["vs_fp32_baseline"] = round(est_cost / ref, 4)
+        fields["bytes_dropped_vs_baseline"] = bool(est_cost < ref)
+    except Exception:
+        pass
+    return fields
+
+
 def main() -> None:
     run_all = "--all" in sys.argv
     if "--chaos" in sys.argv:
@@ -1179,10 +1276,15 @@ def main() -> None:
         return
     if "--smoke" in sys.argv:
         # CI smoke: tiny LeNet config, one stdout JSON line — the CI
-        # ingest job asserts the step_device_ms field parses.  Runs in
-        # seconds on CPU; rates are meaningless at this size.
-        print(json.dumps(bench_lenet(batch=32, steps=8, trials=2,
-                                     pipeline=1)), flush=True)
+        # ingest job asserts the step_device_ms field parses; the CI
+        # perf-smoke job additionally asserts bytes_dropped_vs_baseline
+        # (chip-posture estimate vs the committed fp32 baseline in
+        # tools/perf_baseline.json) and that the deterministic autotune
+        # sub-decision is run-to-run stable.  Rates are meaningless at
+        # this size.
+        result = bench_lenet(batch=32, steps=8, trials=2, pipeline=1)
+        result.update(_smoke_precision_fields(batch=32))
+        print(json.dumps(result), flush=True)
         return
     if "--glove-smoke" in sys.argv:
         # CI embeddings smoke: small fused-vs-naive GloVe run, one stdout

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import precision as _precision
 from . import updaters as _updaters
 from .. import monitor as _monitor
 from .conf.computation_graph import (ComputationGraphConfiguration,
@@ -70,6 +71,15 @@ class ComputationGraph:
         self._pretrain_done = False
         self._rnn_carries: Optional[Dict[str, Any]] = None
         self._rnn_carry_batch = -1
+        self._precision: Optional[_precision.PrecisionPolicy] = None
+
+    def _pol(self) -> _precision.PrecisionPolicy:
+        """The precision policy, resolved once per network instance
+        (docs/PERFORMANCE.md)."""
+        p = self._precision
+        if p is None:
+            p = self._precision = _precision.resolve_policy(self.conf.conf)
+        return p
 
     @functools.cached_property
     def _solver(self):
@@ -89,7 +99,9 @@ class ComputationGraph:
     def init(self) -> "ComputationGraph":
         if self._init_done:
             return self
-        dtype = jnp.dtype(self.conf.conf.dtype)
+        pol = self._pol()
+        _precision.publish(pol)
+        dtype = jnp.dtype(pol.param_dtype)
         key = jax.random.PRNGKey(self.conf.conf.seed)
         self._rng_key = key
         names = [n for n in self.topo
@@ -101,7 +113,8 @@ class ComputationGraph:
             self.net_state[n] = layer.init_state(dtype)
             self.updater_state[n] = _updaters.init_state(
                 self._updater_conf(n),
-                _updaters.updatable_params(layer, self.params[n]))
+                _updaters.updatable_params(layer, self.params[n]),
+                policy=pol)
         self._init_done = True
         return self
 
@@ -130,16 +143,15 @@ class ComputationGraph:
         ``rnnActivateUsingStoredState``)."""
         conf = self.conf
         acts: Dict[str, Array] = {}
-        compute_dtype = conf.conf.compute_dtype
-        in_dtype = jnp.dtype(compute_dtype or conf.conf.dtype)
+        pol = self._pol()
+        compute_dtype = jnp.dtype(pol.compute_dtype)
         for name, x in zip(conf.network_inputs, inputs):
             if jnp.issubdtype(x.dtype, jnp.floating):
-                x = x.astype(in_dtype)
+                x = x.astype(compute_dtype)
             acts[name] = x
-        if compute_dtype:
-            cast = jnp.dtype(compute_dtype)
+        if compute_dtype != jnp.dtype(pol.param_dtype):
             params = jax.tree.map(
-                lambda p: p.astype(cast)
+                lambda p: p.astype(compute_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         new_state = dict(net_state)
         layer_names = self._layer_names()
@@ -166,6 +178,17 @@ class ComputationGraph:
                     if layer.dropout and train:
                         x = layer.apply_dropout(x, train, key_of[name])
                     out = layer.pre_output(params[name], x)
+                elif (pol.downcasts_output and name in conf.network_outputs
+                      and hasattr(layer, "pre_output")
+                      and hasattr(layer, "_activate")
+                      and not (carries is not None and name in carries)):
+                    # fp32 logits contract, head half: output-head logits
+                    # are cast fp32 BEFORE softmax/sigmoid so serving
+                    # probabilities are fp32-exact, not bf16-rounded.
+                    x = layer.apply_dropout(x, train, key_of[name])
+                    out = layer._activate(
+                        layer.pre_output(params[name], x)
+                        .astype(jnp.float32))
                 elif carries is not None and name in carries:
                     out, new_carries[name] = layer.forward_seq(
                         params[name], x, carries[name], train=train,
@@ -187,7 +210,9 @@ class ComputationGraph:
             else:
                 acts[name] = v.apply(*xs, masks=masks)
                 masks[name] = mask
-        if compute_dtype:
+        if pol.downcasts_output:
+            # fp32 logits contract: loss/softmax/metrics accumulation and
+            # serving all consume fp32 even under bf16 storage.
             for out in conf.network_outputs:
                 acts[out] = acts[out].astype(jnp.float32)
         return acts, new_state, new_carries
@@ -498,7 +523,7 @@ class ComputationGraph:
         def dispatch(buf):
             t0 = time.perf_counter()
             features, labels, fms, lms = ingest.stack_multi_window(buf)
-            cdt = self.conf.conf.compute_dtype
+            cdt = self._pol().compute_name
             u8s, wires = ingest.multi_window_wire(buf, len(features))
             features = [
                 u8s[i] if u8s is not None and u8s[i] is not None
@@ -1112,8 +1137,7 @@ class ComputationGraph:
                     "sequence")
 
     def _init_carries(self, batch: int) -> Dict[str, Any]:
-        dtype = jnp.dtype(self.conf.conf.compute_dtype
-                          or self.conf.conf.dtype)
+        dtype = jnp.dtype(self._pol().compute_dtype)
         return {n: self.vertices[n].layer.init_carry(batch, dtype)
                 for n in self._recurrent_vertex_names()}
 
@@ -1359,6 +1383,16 @@ class ComputationGraph:
             raise ValueError(
                 f"Flat param size mismatch: expected {offset}, got "
                 f"{flat.size}")
+        self._sync_masters_from_params()
+
+    def _sync_masters_from_params(self) -> None:
+        """Re-derive fp32 masters after a direct param write; checkpoint
+        restore overwrites them with the saved fp32 values afterwards."""
+        for name, tree in self.updater_state.items():
+            if isinstance(tree, dict) and _updaters.MASTER_KEY in tree:
+                tree[_updaters.MASTER_KEY] = {
+                    k: jnp.asarray(self.params[name][k], jnp.float32)
+                    for k in tree[_updaters.MASTER_KEY]}
 
     def get_flat_updater_state(self) -> np.ndarray:
         self.init()

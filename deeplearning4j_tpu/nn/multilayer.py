@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import precision as _precision
 from . import updaters as _updaters
 from .. import monitor as _monitor
 from .conf.neural_net_configuration import MultiLayerConfiguration
@@ -52,6 +53,16 @@ class MultiLayerNetwork:
         self._pretrain_step_cache: Dict[int, Any] = {}
         self._pretrain_done = False
         self._tbptt_step_cache: Dict[int, Any] = {}
+        self._precision: Optional[_precision.PrecisionPolicy] = None
+
+    def _pol(self) -> _precision.PrecisionPolicy:
+        """The precision policy, resolved once per network instance
+        (docs/PERFORMANCE.md) — param storage dtype, compute dtype,
+        updater-state dtype, and the fp32-master-weights flag."""
+        p = self._precision
+        if p is None:
+            p = self._precision = _precision.resolve_policy(self.conf.conf)
+        return p
 
     @functools.cached_property
     def _solver(self):
@@ -73,7 +84,9 @@ class MultiLayerNetwork:
         """Initialize params/state (reference ``init():384-470``)."""
         if self._init_done:
             return self
-        dtype = jnp.dtype(self.conf.conf.dtype)
+        pol = self._pol()
+        _precision.publish(pol)
+        dtype = jnp.dtype(pol.param_dtype)
         key = jax.random.PRNGKey(self.conf.conf.seed)
         self._rng_key = key
         keys = jax.random.split(key, len(self.layers) + 1)
@@ -85,7 +98,8 @@ class MultiLayerNetwork:
         self.updater_state = [
             _updaters.init_state(
                 self._updater_conf(i),
-                _updaters.updatable_params(self.layers[i], self.params[i]))
+                _updaters.updatable_params(self.layers[i], self.params[i]),
+                policy=pol)
             for i in range(len(self.layers))
         ]
         self._init_done = True
@@ -116,18 +130,18 @@ class MultiLayerNetwork:
         new_carries = list(carries) if carries is not None else [
             () for _ in self.layers]
         keys = (jax.random.split(rng, n) if rng is not None else [None] * n)
-        compute_dtype = self.conf.conf.compute_dtype
+        pol = self._pol()
+        compute_dtype = jnp.dtype(pol.compute_dtype)
         if jnp.issubdtype(x.dtype, jnp.floating):
-            # Cast inputs to the model dtype (params dtype, or the bfloat16
-            # compute dtype for MXU-friendly matmuls); integer inputs
+            # Cast inputs to the policy compute dtype (bfloat16 for
+            # MXU-friendly matmuls under the TPU default); integer inputs
             # (embedding indices) pass through.
-            x = x.astype(jnp.dtype(compute_dtype or self.conf.conf.dtype))
-        if compute_dtype:
-            # Mixed precision: master params stay in the param dtype; compute
+            x = x.astype(compute_dtype)
+        if compute_dtype != jnp.dtype(pol.param_dtype):
+            # Mixed compute: storage params stay in the param dtype; compute
             # sees a bfloat16 copy (XLA fuses the casts into the matmul/conv).
-            cast = jnp.dtype(compute_dtype)
             params = jax.tree.map(
-                lambda p: p.astype(cast)
+                lambda p: p.astype(compute_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         for i in range(from_layer, n):
             layer = self.layers[i]
@@ -142,11 +156,24 @@ class MultiLayerNetwork:
                 x, new_carries[i] = layer.forward_seq(
                     params[i], x, carries[i], train=train, rng=keys[i],
                     mask=mask)
+            elif (pol.downcasts_output and i == len(self.layers) - 1
+                  and hasattr(layer, "pre_output")
+                  and hasattr(layer, "_activate")):
+                # fp32 logits contract, head half: the output head's
+                # logits are cast to fp32 BEFORE the softmax/sigmoid so
+                # serving probabilities are fp32-exact, not bf16-rounded
+                # (bf16 softmax row sums wobble at the 1e-3 level).
+                x = layer.apply_dropout(x, train, keys[i])
+                x = layer._activate(
+                    layer.pre_output(params[i], x).astype(jnp.float32))
             else:
                 x, new_state[i] = layer.forward(
                     params[i], net_state[i], x, train=train, rng=keys[i],
                     mask=mask)
-        if compute_dtype:
+        if pol.downcasts_output:
+            # fp32 logits contract: every consumer (loss, softmax, metrics
+            # accumulation, serving) sees fp32 even under bf16 storage so
+            # Evaluation numbers never drift with the policy.
             x = x.astype(jnp.float32)
         return x, new_state, new_carries
 
@@ -485,7 +512,7 @@ class MultiLayerNetwork:
                 features = u8      # 1 byte/pixel; decode fused on device
             else:
                 features = ingest.cast_for_transfer(
-                    features, self.conf.conf.compute_dtype)
+                    features, self._pol().compute_name)
             features = jnp.asarray(features)
             labels = jnp.asarray(labels)
             fm = None if fm is None else jnp.asarray(fm)
@@ -1087,8 +1114,7 @@ class MultiLayerNetwork:
     def _init_carries(self, batch: int):
         """Zero recurrent carries, one entry per layer (() if stateless)."""
         from .layers.recurrent import BaseRecurrentLayer
-        dtype = jnp.dtype(self.conf.conf.compute_dtype
-                          or self.conf.conf.dtype)
+        dtype = jnp.dtype(self._pol().compute_dtype)
         return [layer.init_carry(batch, dtype)
                 if isinstance(layer, BaseRecurrentLayer) else ()
                 for layer in self.layers]
@@ -1375,6 +1401,19 @@ class MultiLayerNetwork:
         if offset != flat.size:
             raise ValueError(
                 f"Flat param size mismatch: expected {offset}, got {flat.size}")
+        self._sync_masters_from_params()
+
+    def _sync_masters_from_params(self) -> None:
+        """Re-derive the fp32 masters from freshly-assigned params so the
+        master/param coherence invariant holds after a direct param write
+        (param averaging, solvers).  Checkpoint restore overwrites the
+        masters afterwards with the exact saved fp32 values
+        (set_flat_params runs before set_flat_updater_state)."""
+        for i, tree in enumerate(self.updater_state):
+            if isinstance(tree, dict) and _updaters.MASTER_KEY in tree:
+                tree[_updaters.MASTER_KEY] = {
+                    k: jnp.asarray(self.params[i][k], jnp.float32)
+                    for k in tree[_updaters.MASTER_KEY]}
 
     def get_flat_updater_state(self) -> np.ndarray:
         """Updater state as one flat vector (reference

@@ -172,30 +172,53 @@ def normalize_gradients(grads: ParamTree, mode: Optional[str],
 # Per-param updaters (ND4J GradientUpdater equivalents)
 # ---------------------------------------------------------------------------
 
-def init_state(conf: UpdaterConfig, params: ParamTree) -> ParamTree:
+MASTER_KEY = "_master"
+
+
+def init_state(conf: UpdaterConfig, params: ParamTree,
+               policy=None) -> ParamTree:
     """Zero-initialized updater state mirroring the param tree.
 
     Mirrors ND4J ``BaseUpdater`` state layout: adam keeps (m, v), nesterovs
     keeps velocity, adagrad keeps historical sum, etc.  State for stateless
     updaters is an empty tuple so the pytree stays jit-stable.
+
+    With a mixed :class:`~..precision.PrecisionPolicy` the moments are
+    stored in ``policy.updater_dtype`` (fp32 even for bf16 params) and an
+    extra ``"_master"`` tree of fp32 master weights rides alongside —
+    inside the updater state so it is donated/carried/sharded/serialized
+    exactly like the moments (docs/PERFORMANCE.md).
     """
     name = conf.updater.lower()
-    zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+    if policy is not None:
+        sdtype = jnp.dtype(policy.updater_dtype)
+        zeros = lambda: jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), sdtype), params)
+    else:
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
     if name in ("sgd", "none", "noop"):
-        return {}
-    if name == "nesterovs":
-        return {"v": zeros()}
-    if name == "adagrad":
-        return {"h": zeros()}
-    if name == "rmsprop":
-        return {"cache": zeros()}
-    if name == "adam":
-        return {"m": zeros(), "v": zeros()}
-    if name == "adadelta":
-        return {"msg": zeros(), "msdx": zeros()}
-    if name == "lars":
-        return {"v": zeros()}
-    raise ValueError(f"Unknown updater '{conf.updater}'")
+        state: ParamTree = {}
+    elif name == "nesterovs":
+        state = {"v": zeros()}
+    elif name == "adagrad":
+        state = {"h": zeros()}
+    elif name == "rmsprop":
+        state = {"cache": zeros()}
+    elif name == "adam":
+        state = {"m": zeros(), "v": zeros()}
+    elif name == "adadelta":
+        state = {"msg": zeros(), "msdx": zeros()}
+    elif name == "lars":
+        state = {"v": zeros()}
+    else:
+        raise ValueError(f"Unknown updater '{conf.updater}'")
+    if policy is not None and policy.master_weights and any(
+            jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+            and jnp.asarray(p).dtype.itemsize < 4
+            for p in jax.tree_util.tree_leaves(params)):
+        state[MASTER_KEY] = jax.tree.map(
+            lambda p: jnp.asarray(p, jnp.float32), params)
+    return state
 
 
 def compute_update(conf: UpdaterConfig, grads: ParamTree, state: ParamTree,
@@ -307,23 +330,51 @@ def apply_layer_updates(uconf: UpdaterConfig, layer, params: ParamTree,
     gradient normalization, per-param updater rule — with any
     ``layer.direct_update_params()`` routed around all of it and applied
     verbatim (``p -= g``; reference per-param ``Updater.NONE`` + lr 1.0,
-    e.g. center-loss cL)."""
+    e.g. center-loss cL).
+
+    When the updater state carries fp32 masters (mixed-precision policy,
+    see :func:`init_state`), ALL updater math runs against the masters in
+    fp32 and the storage-dtype params are re-derived by one cast at the
+    end — the "cast-on-apply" step.  The bf16 params the forward pass
+    reads are therefore always exactly ``master.astype(bf16)``.
+    """
     if getattr(layer, "frozen", False):
         # feature-extractor layer: parameters (and updater state) fixed
         return dict(params), state
+    masters = state.get(MASTER_KEY) if isinstance(state, dict) else None
     g = dict(grads)
     g_direct = {k: g.pop(k) for k in layer.direct_update_params() if k in g}
-    g = regularize(g, params, layer.l1_by_param(), layer.l2_by_param())
+    if masters is not None:
+        work = {k: masters[k] for k in g}
+        g = {k: jnp.asarray(v, jnp.float32) for k, v in g.items()}
+        mstate = {k: v for k, v in state.items() if k != MASTER_KEY}
+    else:
+        work = {k: params[k] for k in g}
+        mstate = state
+    g = regularize(g, work, layer.l1_by_param(), layer.l2_by_param())
     g = normalize_gradients(g, layer.gradient_normalization,
                             layer.gradient_normalization_threshold)
     updates, new_state = compute_update(
-        uconf, g, state, iteration,
-        params={k: params[k] for k in g})
+        uconf, g, mstate, iteration, params=work)
     new_params = dict(params)
-    for k, u in updates.items():
-        new_params[k] = params[k] - u
+    if masters is not None:
+        new_masters = dict(masters)
+        for k, u in updates.items():
+            new_masters[k] = work[k] - u
+            new_params[k] = new_masters[k].astype(params[k].dtype)
+        new_state = dict(new_state)
+        new_state[MASTER_KEY] = new_masters
+    else:
+        for k, u in updates.items():
+            new_params[k] = params[k] - u
     for k, gd in g_direct.items():
-        new_params[k] = params[k] - gd
+        p = params[k]
+        if (jnp.issubdtype(p.dtype, jnp.floating) and p.dtype.itemsize < 4):
+            # sub-fp32 storage: accumulate the direct step in fp32 too
+            new_params[k] = (p.astype(jnp.float32)
+                             - jnp.asarray(gd, jnp.float32)).astype(p.dtype)
+        else:
+            new_params[k] = p - gd
     return new_params, new_state
 
 

@@ -43,6 +43,11 @@ class Distribution:
 
     def sample(self, rng: jax.Array, shape: Sequence[int],
                dtype=jnp.float32) -> Array:
+        if _is_sub_fp32(dtype):
+            # Sample in fp32 and round once: identical draws whatever the
+            # storage dtype (the mixed-precision policy's bf16 params start
+            # exactly at round(fp32 init), matching the fp32 masters).
+            return self.sample(rng, shape, jnp.float32).astype(dtype)
         if self.kind == "normal" or self.kind == "gaussian":
             return self.mean + self.std * jax.random.normal(rng, shape, dtype)
         if self.kind == "uniform":
@@ -58,6 +63,11 @@ class Distribution:
     @staticmethod
     def from_dict(d: dict) -> "Distribution":
         return Distribution(**d)
+
+
+def _is_sub_fp32(dtype) -> bool:
+    d = jnp.dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating) and d.itemsize < 4
 
 
 def _fans(shape: Sequence[int]) -> tuple[float, float]:
@@ -86,6 +96,12 @@ def init_weights(rng: jax.Array, shape: Sequence[int], scheme: str = "xavier",
     uniform, lecun_normal, lecun_uniform, normal, distribution, identity,
     var_scaling_* aliases.
     """
+    if _is_sub_fp32(dtype):
+        # Sample in fp32, round once to the storage dtype — bf16 params are
+        # then exactly round(fp32 init), bit-matching the fp32 master copies
+        # the mixed-precision updater carries (nn/precision.py).
+        return init_weights(rng, shape, scheme, distribution,
+                            jnp.float32).astype(dtype)
     scheme = scheme.lower()
     fan_in, fan_out = _fans(shape)
     shape = tuple(shape)

@@ -375,6 +375,11 @@ class ParallelWrapper:
             return stack(get)
 
         from ..datasets.dataset import wire_enabled, wire_of
+        from ..nn import ingest as _ingest
+        # bf16 policy: float features cross the host->device wire in the
+        # compute dtype (half the staging bytes); the forward pass would
+        # apply the identical cast on device anyway (nn/precision.py)
+        cdt = net._pol().compute_name
         wire = None
         if self._is_graph:
             from ..nn.computation_graph import _as_multi
@@ -394,7 +399,8 @@ class ParallelWrapper:
                     feats_list.append(stack(lambda m, s=s: m._wires[s][0]))
                     specs.append(mwires[0][s][1].as_tuple())
                 else:
-                    feats_list.append(stack(lambda m, s=s: m.features[s]))
+                    feats_list.append(_ingest.cast_for_transfer(
+                        stack(lambda m, s=s: m.features[s]), cdt))
                     specs.append(None)
             feats = tuple(feats_list)
             if any(x is not None for x in specs):
@@ -420,7 +426,8 @@ class ParallelWrapper:
                 feats = stack(lambda ds: wire_of(ds)[0])
                 wire = ws[0][1].as_tuple()
             else:
-                feats = stack(lambda ds: ds.features)
+                feats = _ingest.cast_for_transfer(
+                    stack(lambda ds: ds.features), cdt)
             labs = stack(lambda ds: ds.labels)
             fmask = stack_masks(lambda ds: ds.features_mask)
             lmask = stack_masks(lambda ds: ds.labels_mask)

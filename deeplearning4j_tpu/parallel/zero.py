@@ -93,7 +93,13 @@ class ZeroShardedParallelWrapper:
     # ---- static flat metadata --------------------------------------------
     def _build(self) -> None:
         net = self.model
+        pol = net._pol()
         flat, self._unravel = ravel_pytree(net.params)
+        self._flat_dtype = np.dtype(flat.dtype)
+        # an fp32 twin of the unravel for state keys stored above the
+        # param dtype (moments and masters under the mixed policy)
+        _, self._unravel_f32 = ravel_pytree(jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), net.params))
         self.total = flat.shape[0]
         n = self.workers
         self.shard = -(-self.total // n)          # ceil
@@ -102,11 +108,20 @@ class ZeroShardedParallelWrapper:
         # so a new updater kind there automatically works here
         state_keys = U.init_state(self.uconf,
                                   jnp.zeros((1,), jnp.float32)).keys()
+        sdtype = jnp.dtype(pol.updater_dtype)
+        state = {k: jnp.zeros((n, self.shard), sdtype) for k in state_keys}
+        self._masters = bool(
+            pol.master_weights and self._flat_dtype.itemsize < 4)
+        if self._masters:
+            # the fp32 master shard IS part of the sharded state: each
+            # replica owns 1/n of the masters, exactly the setting of the
+            # cross-replica weight-update sharding paper (arXiv:2004.13336)
+            state[U.MASTER_KEY] = jnp.pad(
+                flat.astype(jnp.float32),
+                (0, self.padded - self.total)).reshape(n, self.shard)
         # per-replica updater state: ONE shard each (the n-fold saving)
         self._state = jax.device_put(
-            {k: jnp.zeros((n, self.shard), jnp.float32)
-             for k in state_keys},
-            NamedSharding(self.mesh, P("data")))
+            state, NamedSharding(self.mesh, P("data")))
 
     # ------------------------------------------------------------ the step
     @functools.cached_property
@@ -178,9 +193,20 @@ class ZeroShardedParallelWrapper:
             start = widx * shard
             my_g = lax.dynamic_slice(flat_g, (start,), (shard,))
             my_p = lax.dynamic_slice(flat_p_pad, (start,), (shard,))
+            state_shard = dict(state_shard)
+            master = state_shard.pop(U.MASTER_KEY, None)
+            if master is not None:
+                # mixed policy: updater math against the fp32 master shard,
+                # one cast back to the storage dtype (cast-on-apply)
+                my_g = my_g.astype(jnp.float32)
             updates, new_state = U.compute_update(
-                uconf, my_g, dict(state_shard), iteration)
-            new_slice = my_p - updates
+                uconf, my_g, state_shard, iteration)
+            if master is not None:
+                new_master = master - updates
+                new_state[U.MASTER_KEY] = new_master
+                new_slice = new_master.astype(my_p.dtype)
+            else:
+                new_slice = my_p - updates
             # each replica emits ONLY its slice; the out spec reassembles
             # the flat vector and XLA inserts the all-gather where the
             # next consumer needs it replicated
@@ -233,7 +259,10 @@ class ZeroShardedParallelWrapper:
         per_key = {}
         for key, sharded in self._state.items():
             flat = np.asarray(sharded).reshape(-1)[:self.total]
-            per_key[key] = self._unravel(jnp.asarray(flat))
+            unravel = (self._unravel
+                       if np.dtype(sharded.dtype) == self._flat_dtype
+                       else self._unravel_f32)
+            per_key[key] = unravel(jnp.asarray(flat))
         net.updater_state = [
             {key: per_key[key][i] for key in per_key}
             for i in range(len(net.layers))]

@@ -243,6 +243,7 @@ def snapshot(net, step_in_epoch: int = 0) -> Dict[str, Any]:
             score = float(np.asarray(score))
         except Exception:
             score = None
+    pol = net._pol() if hasattr(net, "_pol") else None
     resume = {
         "epoch": int(getattr(net, "epoch", 0)),
         "iteration": int(getattr(net, "iteration", 0)),
@@ -252,6 +253,10 @@ def snapshot(net, step_in_epoch: int = 0) -> Dict[str, Any]:
         "score": score,
         "model_class": type(net).__name__,
         "wall_time": time.time(),
+        # the precision policy shapes the updater-state layout (fp32
+        # masters ride updaterState.bin); a resume under a different
+        # policy cannot line up, so stamp it for the restore-side check
+        "precision": pol.describe() if pol is not None else None,
     }
     return {
         "config": net.conf.to_json(),
@@ -329,6 +334,16 @@ def restore(net, path: str) -> ResumeState:
     net.init()
     with zipfile.ZipFile(path, "r") as zf:
         names = set(zf.namelist())
+        if RESUME_JSON in names:
+            saved_pol = json.loads(zf.read(RESUME_JSON)).get("precision")
+            cur_pol = (net._pol().describe()
+                       if hasattr(net, "_pol") else None)
+            if saved_pol and cur_pol and saved_pol != cur_pol:
+                raise CheckpointCorruptError(
+                    f"{path}: checkpoint was written under precision "
+                    f"policy {saved_pol} but this process resolves "
+                    f"{cur_pol}; set DL4J_TPU_PRECISION to match before "
+                    "resuming")
         _restore_into(net, zf, load_updater=True)
         resume = (json.loads(zf.read(RESUME_JSON))
                   if RESUME_JSON in names else {})

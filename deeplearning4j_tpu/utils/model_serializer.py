@@ -224,4 +224,9 @@ def _unflatten_state(net, flat: np.ndarray, manifest) -> None:
         target = net.net_state[i]
         for k in keys[:-1]:
             target = target[k]
+        prev = target.get(keys[-1]) if isinstance(target, dict) else None
+        if prev is not None and hasattr(prev, "dtype"):
+            # restore into the network's storage dtype (bf16 net state under
+            # the mixed policy round-trips losslessly through the fp32 wire)
+            value = value.astype(prev.dtype)
         target[keys[-1]] = value

@@ -19,10 +19,9 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
 def main(num_examples: int = 6400, epochs: int = 2) -> float:
-    import jax
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    from deeplearning4j_tpu.nn.precision import default_compute_dtype
     net = MultiLayerNetwork(
-        lenet(compute_dtype="bfloat16" if on_tpu else None)).init()
+        lenet(compute_dtype=default_compute_dtype())).init()
 
     # AsyncDataSetIterator rides the C++ prefetch ring when the native
     # lib builds (shuffle + batch gather off the GIL)

@@ -28,9 +28,10 @@ import numpy as np
 
 
 def _bf16_if_tpu():
-    import jax
-    return ("bfloat16" if any(d.platform == "tpu" for d in jax.devices())
-            else None)
+    # shared backend-default from the precision module (DL4J_TPU_PRECISION
+    # aware) — see docs/PERFORMANCE.md
+    from deeplearning4j_tpu.nn.precision import default_compute_dtype
+    return default_compute_dtype()
 
 
 def _listeners(ckpt_dir, every_iter, stats_freq=50):

@@ -1,0 +1,96 @@
+"""Step autotuner tests (tools/autotune.py, docs/PERFORMANCE.md).
+
+The acceptance bar: the deterministic mode ranks rungs purely off the
+compiled programs' XLA cost model, so the decision is byte-identical
+across runs for a fixed (model-signature, backend) — CI can diff two
+runs.  Plus: cache round trip, HBM-cap filtering, and apply_decision
+wiring into the fused-scan dispatch knob.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools import autotune
+
+
+@pytest.fixture(autouse=True)
+def _no_cache_env(monkeypatch, tmp_path):
+    # point the cache at a throwaway file so tests never touch (or get
+    # polluted by) the developer's ~/.cache decisions
+    monkeypatch.setenv(autotune.CACHE_ENV, str(tmp_path / "autotune.json"))
+    monkeypatch.delenv(autotune.CAP_ENV, raising=False)
+    monkeypatch.delenv(autotune.DET_ENV, raising=False)
+    monkeypatch.delenv("DL4J_TPU_PRECISION", raising=False)
+    yield
+
+
+def test_deterministic_decision_is_stable():
+    a = autotune.autotune("mlp", deterministic=True, use_cache=False,
+                          smoke=True)
+    b = autotune.autotune("mlp", deterministic=True, use_cache=False,
+                          smoke=True)
+    assert a["mode"] == "deterministic"
+    assert not a.get("cached")
+    for key in ("signature", "backend", "batch", "steps_per_dispatch",
+                "bytes_per_sample", "policy"):
+        assert a[key] == b[key], key
+    # full rung table identical too (the CI diff is over all of it)
+    assert json.dumps(a["rungs"], sort_keys=True) == \
+        json.dumps(b["rungs"], sort_keys=True)
+
+
+def test_decision_prefers_lowest_bytes_per_sample():
+    d = autotune.autotune("mlp", deterministic=True, use_cache=False,
+                          smoke=True)
+    ok = [r for r in d["rungs"]
+          if "error" not in r and "skipped" not in r]
+    assert ok
+    best = min(r["bytes_per_sample"] for r in ok)
+    chosen = [r for r in ok if r["batch"] == d["batch"]
+              and r["steps"] == d["steps_per_dispatch"]]
+    assert chosen and chosen[0]["bytes_per_sample"] == best
+
+
+def test_cache_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv(autotune.CACHE_ENV, str(tmp_path / "c.json"))
+    first = autotune.autotune("mlp", deterministic=True, use_cache=True,
+                              smoke=True)
+    assert not first.get("cached")
+    again = autotune.autotune("mlp", deterministic=True, use_cache=True,
+                              smoke=True)
+    assert again.get("cached")
+    assert again["batch"] == first["batch"]
+    assert again["steps_per_dispatch"] == first["steps_per_dispatch"]
+    assert again["signature"] == first["signature"]
+    # the cache file itself is valid json keyed by signature
+    blob = json.loads(open(str(tmp_path / "c.json")).read())
+    assert any(v.get("signature") == first["signature"]
+               for v in blob.values())
+
+
+def test_apply_decision_sets_dispatch_env(monkeypatch):
+    monkeypatch.delenv(autotune.DISPATCH_ENV, raising=False)
+    decision = {"batch": 64, "steps_per_dispatch": 32}
+    batch = autotune.apply_decision(decision)
+    assert batch == 64
+    assert os.environ[autotune.DISPATCH_ENV] == "32"
+
+
+def test_hbm_cap_filters_every_rung(monkeypatch):
+    monkeypatch.setenv(autotune.CAP_ENV, "0.000001")   # ~1 KB cap
+    with pytest.raises(RuntimeError, match="HBM cap"):
+        autotune.autotune("mlp", deterministic=True, use_cache=False,
+                          smoke=True)
+
+
+def test_signature_tracks_policy(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "mixed_bf16")
+    d_mixed = autotune.autotune("mlp", deterministic=True, use_cache=False,
+                                smoke=True)
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "fp32")
+    d_fp32 = autotune.autotune("mlp", deterministic=True, use_cache=False,
+                               smoke=True)
+    assert d_mixed["policy"] != d_fp32["policy"]
+    assert d_mixed["signature"] != d_fp32["signature"]

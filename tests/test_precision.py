@@ -1,0 +1,298 @@
+"""Mixed-precision residency tests (docs/PERFORMANCE.md).
+
+What this file pins down:
+
+- policy resolution precedence: DL4J_TPU_PRECISION env > explicit conf
+  dtypes > backend default (fp32 on CPU, mixed_bf16 on TPU);
+- fp32-master semantics: under mixed_bf16 the resident params are bf16,
+  the updater carries fp32 masters, and after every fit the coherence
+  invariant params == cast(masters, bf16) holds exactly;
+- training parity: mixed_bf16 (bf16 storage + fp32 masters) tracks full
+  fp32 training within bf16 rounding tolerance on the MLN batch path,
+  the fused-scan epoch path, and a ComputationGraph;
+- eval/serving: logits leave the net as fp32 and softmax/metrics run at
+  fp32 no matter the residency policy (the serving regression);
+- serialization: write_model/restore preserves bf16 params and the
+  exact fp32 masters.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import precision, updaters
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.utils.model_serializer import (
+    restore_multi_layer_network, write_model)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv(precision._ENV, raising=False)
+    yield
+
+
+def _conf(seed=7, n_in=6, n_out=3, dtype=None, compute_dtype=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater("adam").learning_rate(0.05)
+         .activation("tanh").weight_init("xavier"))
+    if dtype is not None:
+        b = b.dtype(dtype)
+    if compute_dtype is not None:
+        b = b.compute_dtype(compute_dtype)
+    return (b.list()
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(inputs.feed_forward(n_in))
+            .build())
+
+
+def _net(**kw):
+    return MultiLayerNetwork(_conf(**kw)).init()
+
+
+def _data(n=64, n_in=6, n_out=3, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out)[rng.randint(0, n_out, n)].astype(np.float32)
+    return X, y
+
+
+def _iterator(batch=8, **kw):
+    X, y = _data(**kw)
+    return ListDataSetIterator(DataSet(X, y), batch)
+
+
+def _flat32(net):
+    return np.asarray(net.get_flat_params(), np.float32)
+
+
+# ------------------------------------------------- policy resolution
+
+def test_cpu_default_is_fp32():
+    pol = precision.resolve_policy(_conf().conf)
+    if precision.on_tpu():            # pragma: no cover - TPU CI only
+        assert pol.name == "mixed_bf16"
+    else:
+        assert pol.name == "fp32"
+        assert pol.param_dtype == "float32"
+        assert not pol.master_weights
+
+
+@pytest.mark.parametrize("alias", ["mixed_bf16", "mixed",
+                                   "bf16_fp32_master"])
+def test_env_selects_mixed_policy(monkeypatch, alias):
+    monkeypatch.setenv(precision._ENV, alias)
+    pol = precision.resolve_policy(_conf().conf)
+    assert pol.name == "mixed_bf16"
+    assert pol.param_dtype == "bfloat16"
+    assert pol.compute_dtype == "bfloat16"
+    assert pol.updater_dtype == "float32"
+    assert pol.master_weights
+
+
+def test_env_overrides_explicit_conf(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "fp32")
+    pol = precision.resolve_policy(
+        _conf(dtype="bfloat16", compute_dtype="bfloat16").conf)
+    assert pol.name == "fp32"
+
+
+def test_env_rejects_unknown_mode(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "fp8_dreams")
+    with pytest.raises(ValueError, match="DL4J_TPU_PRECISION"):
+        precision.resolve_policy(_conf().conf)
+
+
+def test_explicit_compute_dtype_keeps_fp32_params():
+    pol = precision.resolve_policy(_conf(compute_dtype="bfloat16").conf)
+    assert pol.param_dtype == "float32"
+    assert pol.compute_dtype == "bfloat16"
+    assert not pol.master_weights
+
+
+def test_explicit_bf16_storage_gets_masters():
+    pol = precision.resolve_policy(_conf(dtype="bfloat16").conf)
+    assert pol.param_dtype == "bfloat16"
+    assert pol.master_weights
+    assert pol.updater_dtype == "float32"
+
+
+def test_default_compute_dtype_follows_env(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    assert precision.default_compute_dtype() == "bfloat16"
+    monkeypatch.setenv(precision._ENV, "fp32")
+    assert precision.default_compute_dtype() is None   # conf default wins
+
+
+# ---------------------------------------- residency + master weights
+
+def test_mixed_params_resident_bf16_with_fp32_masters(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _net()
+    assert net._pol().name == "mixed_bf16"
+    for leaf in jax.tree.leaves(net.params):
+        assert leaf.dtype == jnp.bfloat16
+    saw_master = False
+    for layer_state in net.updater_state:
+        if isinstance(layer_state, dict) and updaters.MASTER_KEY in layer_state:
+            saw_master = True
+            for leaf in jax.tree.leaves(layer_state[updaters.MASTER_KEY]):
+                assert leaf.dtype == jnp.float32
+    assert saw_master
+
+
+def test_bf16_init_is_rounded_fp32_init(monkeypatch):
+    net32 = _net()
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net16 = _net()
+    for a, b in zip(jax.tree.leaves(net32.params),
+                    jax.tree.leaves(net16.params)):
+        np.testing.assert_array_equal(
+            np.asarray(a.astype(jnp.bfloat16).astype(jnp.float32)),
+            np.asarray(b.astype(jnp.float32)))
+
+
+def test_master_param_coherence_after_fit(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _net()
+    net.fit(_iterator(), epochs=2)
+    for layer_params, layer_state in zip(net.params, net.updater_state):
+        if not (isinstance(layer_state, dict)
+                and updaters.MASTER_KEY in layer_state):
+            continue
+        masters = layer_state[updaters.MASTER_KEY]
+        for k, p in layer_params.items():
+            assert p.dtype == jnp.bfloat16
+            m = masters[k]
+            assert m.dtype == jnp.float32
+            np.testing.assert_array_equal(
+                np.asarray(p.astype(jnp.float32)),
+                np.asarray(m.astype(jnp.bfloat16).astype(jnp.float32)))
+
+
+# -------------------------------------------------- training parity
+
+def _fit_flat(monkeypatch, mode, epochs=2, ingest=None):
+    if mode is None:
+        monkeypatch.delenv(precision._ENV, raising=False)
+    else:
+        monkeypatch.setenv(precision._ENV, mode)
+    net = _net()
+    kw = {"epochs": epochs}
+    if ingest:
+        kw["ingest"] = ingest
+    net.fit(_iterator(), **kw)
+    return _flat32(net), float(net.score())
+
+
+def test_mln_mixed_matches_fp32_fused_scan(monkeypatch):
+    """fp32-master mixed precision tracks full fp32 on the fused-scan
+    epoch path: drift is bounded by bf16 rounding, not divergence."""
+    ref, ref_score = _fit_flat(monkeypatch, "fp32")
+    got, got_score = _fit_flat(monkeypatch, "mixed_bf16")
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got, ref, atol=0.05, rtol=0.05)
+    assert abs(got_score - ref_score) < 0.1
+
+
+def test_mln_mixed_matches_fp32_batch_path(monkeypatch):
+    ref, _ = _fit_flat(monkeypatch, "fp32", ingest="batch")
+    got, _ = _fit_flat(monkeypatch, "mixed_bf16", ingest="batch")
+    np.testing.assert_allclose(got, ref, atol=0.05, rtol=0.05)
+
+
+def _cg(seed=12345):
+    g = (NeuralNetConfiguration.builder()
+         .seed(seed).updater("adam").learning_rate(0.05)
+         .activation("tanh").weight_init("xavier")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("dense", DenseLayer(n_in=6, n_out=10), "in")
+         .add_layer("out", OutputLayer(n_in=10, n_out=3), "dense")
+         .set_outputs("out")
+         .build())
+    return ComputationGraph(g).init()
+
+
+def test_cg_mixed_matches_fp32(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "fp32")
+    ref = _cg()
+    ref.fit(_iterator(), epochs=2)
+    ref_flat = np.concatenate(
+        [np.asarray(l, np.float32).ravel()
+         for l in jax.tree.leaves(ref.params)])
+
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _cg()
+    assert net._pol().name == "mixed_bf16"
+    for leaf in jax.tree.leaves(net.params):
+        assert leaf.dtype == jnp.bfloat16
+    net.fit(_iterator(), epochs=2)
+    got_flat = np.concatenate(
+        [np.asarray(l, np.float32).ravel()
+         for l in jax.tree.leaves(net.params)])
+    np.testing.assert_allclose(got_flat, ref_flat, atol=0.05, rtol=0.05)
+
+
+# ------------------------------------------------------ eval/serving
+
+def test_output_logits_are_fp32_under_bf16(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _net()
+    X, _ = _data(n=16)
+    out = np.asarray(net.output(X))
+    assert out.dtype == np.float32
+    # softmax at fp32: rows are proper distributions to fp32 accuracy,
+    # not bf16 accuracy (bf16 row sums wobble at the 1e-2 level)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_evaluate_runs_fp32_metrics_under_bf16(monkeypatch):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _net()
+    net.fit(_iterator(), epochs=1)
+    ev = net.evaluate(_iterator())
+    assert 0.0 <= ev.accuracy() <= 1.0
+    ev2 = net.evaluate(_iterator())
+    assert ev.accuracy() == ev2.accuracy()      # deterministic serving
+
+
+# ----------------------------------------------------- serialization
+
+def test_write_restore_preserves_bf16_params_and_masters(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv(precision._ENV, "mixed_bf16")
+    net = _net()
+    net.fit(_iterator(), epochs=1)
+    path = str(tmp_path / "model.zip")
+    write_model(net, path)
+    again = restore_multi_layer_network(path)
+    for a, b in zip(jax.tree.leaves(net.params),
+                    jax.tree.leaves(again.params)):
+        assert b.dtype == a.dtype
+        np.testing.assert_array_equal(np.asarray(a.astype(jnp.float32)),
+                                      np.asarray(b.astype(jnp.float32)))
+    for sa, sb in zip(net.updater_state, again.updater_state):
+        if isinstance(sa, dict) and updaters.MASTER_KEY in sa:
+            assert updaters.MASTER_KEY in sb
+            for ma, mb in zip(
+                    jax.tree.leaves(sa[updaters.MASTER_KEY]),
+                    jax.tree.leaves(sb[updaters.MASTER_KEY])):
+                assert mb.dtype == jnp.float32
+                np.testing.assert_array_equal(np.asarray(ma),
+                                              np.asarray(mb))
+    X, _ = _data(n=8)
+    np.testing.assert_array_equal(np.asarray(net.output(X)),
+                                  np.asarray(again.output(X)))

@@ -435,3 +435,72 @@ def test_faults_env_parsing(monkeypatch):
     t0 = time.perf_counter()
     faults.slow_worker()
     assert time.perf_counter() - t0 >= 0.001
+
+
+# ------------------------------------- mixed-precision checkpointing
+
+def test_mid_epoch_resume_bit_identical_mixed_bf16(tmp_path, monkeypatch):
+    """Preemption safety survives the precision policy: under mixed_bf16
+    (bf16 resident params + fp32 masters in the updater) a mid-epoch
+    resume reproduces the uninterrupted run bit-for-bit — the fp32
+    masters round-trip exactly, and bf16 params are their lossless
+    downcast."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn import updaters
+
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "mixed_bf16")
+    ref = chaos.build_net()
+    assert ref._pol().master_weights
+    for leaf in jax.tree.leaves(ref.params):
+        assert leaf.dtype == jnp.bfloat16
+    ref.fit(chaos.build_iterator(), epochs=3)
+
+    net = chaos.build_net()
+    mgr = CheckpointManager(str(tmp_path / "ck"), every_steps=3,
+                            keep_last=8)
+    net.fit(chaos.build_iterator(), epochs=3, checkpoint=mgr)
+    assert _params_sha(net) == _params_sha(ref)
+
+    cks = list_checkpoints(str(tmp_path / "ck"))
+    mid = [p for p in cks
+           if json.loads(zipfile.ZipFile(p).read("resume.json"))
+           ["step_in_epoch"] > 0][0]
+
+    net2 = chaos.build_net()
+    net2.fit(chaos.build_iterator(), epochs=3, resume_from=mid)
+    assert net2.iteration == ref.iteration
+    assert _params_sha(net2) == _params_sha(ref)
+    # masters resumed exactly fp32 and coherent with the bf16 params
+    saw_master = False
+    for lp, ls, rs in zip(net2.params, net2.updater_state,
+                          ref.updater_state):
+        if not (isinstance(ls, dict) and updaters.MASTER_KEY in ls):
+            continue
+        saw_master = True
+        for k in ls[updaters.MASTER_KEY]:
+            m, rm = ls[updaters.MASTER_KEY][k], rs[updaters.MASTER_KEY][k]
+            assert m.dtype == jnp.float32
+            np.testing.assert_array_equal(np.asarray(m), np.asarray(rm))
+            np.testing.assert_array_equal(
+                np.asarray(lp[k].astype(jnp.float32)),
+                np.asarray(m.astype(jnp.bfloat16).astype(jnp.float32)))
+    assert saw_master
+
+
+def test_resume_rejects_precision_policy_mismatch(tmp_path, monkeypatch):
+    """A checkpoint written under one precision policy refuses to load
+    into a process resolving another: fp32 masters vs no-masters layouts
+    cannot line up, so the mismatch is a diagnostic, not garbage."""
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "mixed_bf16")
+    net = chaos.build_net()
+    mgr = CheckpointManager(str(tmp_path), every_steps=3, keep_last=8,
+                            async_write=False)
+    net.fit(chaos.build_iterator(), epochs=1, checkpoint=mgr)
+    ck = list_checkpoints(str(tmp_path))[-1]
+
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "fp32")
+    net2 = chaos.build_net()
+    with pytest.raises(CheckpointCorruptError, match="precision policy"):
+        restore(net2, ck)     # explicit file: no latest() fallback

@@ -1,0 +1,2 @@
+# repo tooling (hbm_profile, autotune); a package so bench.py and the
+# tests can import the shared helpers without path games.

@@ -19,6 +19,12 @@ memory-tuning surface.
 Usage: python tools/hbm_profile.py
            [resnet|lenet|vgg|gather|glove|glove-naive] [top_n]
 
+The audit defaults to the **TPU default precision policy**
+(``mixed_bf16``: bf16 params + bf16 activations + fp32 masters in the
+updater state) even on CPU, so the cost-model/HLO numbers reflect the
+program the chip would actually run.  Set ``DL4J_TPU_PRECISION=fp32``
+to audit the fp32 program instead and compare bytes side by side.
+
 ``gather`` profiles the epoch-cache v2 program
 (``MultiLayerNetwork._gather_train_step``): on-device threefry epoch
 permutation, per-step row gather from the resident uint8 cache, fused
@@ -44,16 +50,20 @@ _SHAPE_RE = re.compile(r"(f64|f32|bf16|f16|s64|s32|u64|u32|s16|u16|s8|u8"
                        r"|pred)\[([0-9,]*)\]")
 
 
-def shape_bytes(shape_str: str) -> int:
+def shape_bytes(shape_str: str, by_dtype=None) -> int:
     """Total bytes of every array shape mentioned in an HLO type string
-    (handles tuples by summing members)."""
+    (handles tuples by summing members).  When ``by_dtype`` (a dict) is
+    given, per-dtype byte totals are accumulated into it as well."""
     total = 0
     for dtype, dims in _SHAPE_RE.findall(shape_str):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
+        b = n * _DTYPE_BYTES[dtype]
+        total += b
+        if by_dtype is not None:
+            by_dtype[dtype] = by_dtype.get(dtype, 0) + b
     return total
 
 
@@ -64,8 +74,9 @@ _OPERAND_RE = re.compile(r"%([\w.\-]+)")
 
 
 def profile_hlo(hlo_text: str):
-    """Parse optimized HLO; return (rows, total_bytes) where rows are
-    ALL (bytes, op_kind, name, out_shape) entries, largest first.
+    """Parse optimized HLO; return (rows, total_bytes, by_dtype) where
+    rows are ALL (bytes, op_kind, name, out_shape) entries, largest
+    first, and by_dtype maps HLO dtype tag -> traffic bytes.
 
     Computation-aware: instructions INSIDE fusion bodies
     (``%fused_computation*``) and scalar reducer/comparator regions are
@@ -85,6 +96,7 @@ def profile_hlo(hlo_text: str):
             "copy-done", "after-all", "partition-id"}
     rows = []
     total = 0
+    by_dtype = {}
     in_excluded = False
     depth = 0
     for line in hlo_text.splitlines():
@@ -112,24 +124,25 @@ def profile_hlo(hlo_text: str):
         name, out_shape, kind, rest = m.groups()
         if kind in skip or kind.endswith("-start"):
             continue      # -start halves pair with -done; count once
-        out_b = shape_bytes(out_shape)
+        out_b = shape_bytes(out_shape, by_dtype)
         if kind in ("slice", "dynamic-slice", "dynamic-update-slice",
                     "broadcast", "reshape", "transpose", "reverse"):
             # These read/write only the window/output, not the full
             # operand: charging operand bytes overstated slices to 42%
             # of ResNet's total.  (dynamic-update-slice writes a
             # window into an aliased buffer: window read + write.)
+            shape_bytes(out_shape, by_dtype)
             b = 2 * out_b
         else:
             arg_str = rest.split(", calls=")[0].split(", metadata=")[0]
             b = out_b
             for op in _OPERAND_RE.findall(arg_str):
                 if op in shapes:
-                    b += shape_bytes(shapes[op])
+                    b += shape_bytes(shapes[op], by_dtype)
         rows.append((b, kind, name, out_shape))
         total += b
     rows.sort(reverse=True)
-    return rows, total
+    return rows, total, by_dtype
 
 
 def _classify(kind: str, name: str, shape: str) -> str:
@@ -148,26 +161,31 @@ def compiled_step(config: str):
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.nn.precision import default_compute_dtype
+    cdt = default_compute_dtype()       # DL4J_TPU_PRECISION-aware
+
     if config == "resnet":
         from deeplearning4j_tpu.models.resnet import resnet50
         from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
-        net = ComputationGraph(resnet50(compute_dtype="bfloat16")).init()
+        net = ComputationGraph(resnet50(compute_dtype=cdt)).init()
+        fdt = jnp.dtype(net._pol().compute_dtype)
         batch = 128
-        f = [jnp.zeros((1, batch, 224, 224, 3), jnp.bfloat16)]
+        f = [jnp.zeros((1, batch, 224, 224, 3), fdt)]
         l = [jnp.zeros((1, batch, 1000), jnp.float32)]
     elif config == "vgg":
         from deeplearning4j_tpu.keras.trained_models import vgg16
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        net = MultiLayerNetwork(vgg16(compute_dtype="bfloat16")).init()
+        net = MultiLayerNetwork(vgg16(compute_dtype=cdt)).init()
+        fdt = jnp.dtype(net._pol().compute_dtype)
         batch = 256
-        f = jnp.zeros((1, batch, 224, 224, 3), jnp.bfloat16)
+        f = jnp.zeros((1, batch, 224, 224, 3), fdt)
         l = jnp.zeros((1, batch, 1000), jnp.float32)
     elif config == "gather":
         # epoch-cache v2: resident uint8 MNIST cache, device threefry
         # permutation, row gather + fused decode, one-epoch scan
         from deeplearning4j_tpu.models.lenet import lenet
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        net = MultiLayerNetwork(lenet(compute_dtype="bfloat16")).init()
+        net = MultiLayerNetwork(lenet(compute_dtype=cdt)).init()
         n, batch = 60000, 256
         f = jnp.zeros((n, 784), jnp.uint8)
         l = jnp.zeros((n, 10), jnp.float32)
@@ -176,7 +194,7 @@ def compiled_step(config: str):
         args = (net.params, net.updater_state, net.net_state,
                 net.iteration, f, l, net._rng_key, shuffle_key, 0, 1,
                 steps, batch, True, 0, (255.0, 1.0, 0.0), 0, steps)
-        return net._gather_train_step.lower(*args).compile()
+        return net._gather_train_step.lower(*args).compile(), net
     elif config in ("glove", "glove-naive"):
         # scatter-row audit for the embedding economics work: compile a
         # 1-chunk GloVe epoch twin and count its scatter instructions.
@@ -197,22 +215,97 @@ def compiled_step(config: str):
             Sr = jnp.zeros((V, 2 * D + 2), jnp.float32)
             Sc = jnp.zeros((V, 2 * D + 2), jnp.float32)
             return _glove_epoch_fused.lower(Sr, Sc, rows, cols, logx,
-                                            fx, order, lr).compile()
+                                            fx, order, lr).compile(), None
         W = jnp.zeros((V, D), jnp.float32)
         tabs = (W, W + 0, jnp.zeros((V,)), jnp.zeros((V,)), W + 0,
                 W + 0, jnp.zeros((V,)), jnp.zeros((V,)))
         return _glove_epoch.lower(*tabs, rows, cols, logx, fx,
-                                  order, lr).compile()
+                                  order, lr).compile(), None
     else:
         from deeplearning4j_tpu.models.lenet import lenet
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        net = MultiLayerNetwork(lenet(compute_dtype="bfloat16")).init()
+        net = MultiLayerNetwork(lenet(compute_dtype=cdt)).init()
+        fdt = jnp.dtype(net._pol().compute_dtype)
         batch = 256
-        f = jnp.zeros((1, batch, 784), jnp.bfloat16)
+        f = jnp.zeros((1, batch, 784), fdt)
         l = jnp.zeros((1, batch, 10), jnp.float32)
     args = (net.params, net.updater_state, net.net_state, net.iteration,
             f, l, None, None, net._rng_key)
-    return net._multi_train_step.lower(*args).compile()
+    return net._multi_train_step.lower(*args).compile(), net
+
+
+# The recorded fp32 LeNet row this campaign is measured against
+# (BENCH_r05.json batch-256 hbm_bytes_per_step; ISSUE 7 acceptance).
+BENCH_R05_LENET_BYTES = 117_648_384
+
+# configs whose step comes from a network (fp32 twin is comparable)
+_NET_CONFIGS = ("resnet", "lenet", "vgg", "gather")
+
+
+def chip_posture_estimate(total_f32: float, f32_traffic: float,
+                          moments_io: float, master_io: float,
+                          masters: bool) -> float:
+    """Project the fp32 program's traffic onto the chip under the bf16
+    policy: every f32 buffer the fp32 program streams becomes bf16 on
+    the TPU (activations, params, grads — x0.5) EXCEPT the updater
+    moments, which the mixed policy keeps fp32 (restored at full width),
+    plus one fp32 read + write of the master copies per step.  CPU-XLA
+    cannot show this directly — it upcasts bf16 conv/dot to f32 through
+    convert fusions, so the raw bf16-program cost model OVERSTATES chip
+    traffic (measured: LeNet b256 366 MB bf16 vs 324 MB fp32)."""
+    est = total_f32 - 0.5 * f32_traffic + 0.5 * moments_io
+    if masters:
+        est += master_io
+    return est
+
+
+def _policy_comparison(config: str, pol, cost_bytes_pol: float) -> None:
+    """Compile the fp32 twin of ``config`` and print the CPU-posture
+    bytes comparison (ISSUE 7 acceptance: LeNet bytes/step must
+    measurably drop under the default TPU policy)."""
+    import jax
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.nn import precision
+
+    prev = os.environ.get(precision._ENV)
+    os.environ[precision._ENV] = precision.FP32
+    try:
+        compiled32, net32 = compiled_step(config)
+    finally:
+        os.environ[precision._ENV] = prev
+    cost32 = compiled32.cost_analysis()
+    if isinstance(cost32, list):
+        cost32 = cost32[0]
+    cost32_b = float(cost32.get("bytes accessed", 0.0))
+    _, total32, by_dtype32 = profile_hlo(compiled32.as_text())
+    moments_io = 2 * sum(int(l.size) * l.dtype.itemsize
+                         for l in jax.tree.leaves(net32.updater_state))
+    master_io = 2 * 4 * sum(int(l.size)
+                            for l in jax.tree.leaves(net32.params))
+    est = chip_posture_estimate(total32, by_dtype32.get("f32", 0),
+                                moments_io, master_io,
+                                pol.master_weights)
+    ratio = est / total32 if total32 else 1.0
+    print(f"\n# precision comparison ({pol.name} vs fp32, CPU posture)")
+    print(f"#   fp32 program:   cost model {cost32_b:,.0f} B/step; "
+          f"parsed {total32/1e6:.0f} MB")
+    print(f"#   {pol.name} program: cost model {cost_bytes_pol:,.0f} "
+          f"B/step (CPU convert overhead included)")
+    print(f"#   chip-posture estimate (f32 traffic at policy widths, "
+          f"moments fp32, masters r/w): {est:,.0f} B "
+          f"= x{ratio:.3f} vs fp32")
+    print(f"#   projected xla_cost_bytes_accessed on chip: "
+          f"{cost32_b * ratio:,.0f} B/step")
+    if config == "lenet":
+        print(f"#   projected BENCH_r05 LeNet row: "
+              f"{BENCH_R05_LENET_BYTES:,} -> "
+              f"{BENCH_R05_LENET_BYTES * ratio:,.0f} B/step")
+    g = monitor.gauge("hbm_profile_policy_bytes",
+                      "CPU-posture precision-policy bytes comparison "
+                      "(parsed HLO traffic per train step)")
+    g.set(float(total32), config=config, program="fp32")
+    g.set(float(est), config=config, program="chip_estimate")
 
 
 def register_monitor_gauges(config: str, by_class: dict,
@@ -234,12 +327,19 @@ def register_monitor_gauges(config: str, by_class: dict,
 def main() -> int:
     config = sys.argv[1] if len(sys.argv) > 1 else "resnet"
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 15
-    compiled = compiled_step(config)
+    # CPU-posture audit: compile the program the TPU default policy would
+    # run unless the caller pinned a mode (DL4J_TPU_PRECISION=fp32 gives
+    # the fp32 comparison row).
+    os.environ.setdefault("DL4J_TPU_PRECISION", "mixed_bf16")
+    from deeplearning4j_tpu.nn import precision
+    pol = precision.named_policy(precision.env_mode())
+    print(f"# precision policy: {pol.describe()}")
+    compiled, _net = compiled_step(config)
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
         cost = cost[0]
     hlo = compiled.as_text()
-    all_rows, total = profile_hlo(hlo)
+    all_rows, total, _by_dtype = profile_hlo(hlo)
     rows = all_rows[:top_n]
     print(f"# {config}: top {top_n} HBM-consuming ops "
           f"(parsed {total/1e6:.0f} MB/step; XLA cost model "
@@ -288,6 +388,9 @@ def main() -> int:
               f"step; {hlo.count('unique_indices=true')} instruction(s) "
               f"marked unique_indices=true")
     register_monitor_gauges(config, by_class, total)
+    if config in _NET_CONFIGS and pol.name != "fp32":
+        _policy_comparison(config, pol,
+                           float(cost.get("bytes accessed", 0.0)))
     return 0
 
 
