@@ -1146,6 +1146,217 @@ def bench_serving(n_in: int = 64, hidden: int = 256, n_out: int = 10,
             "max_batch": max_batch, "max_latency_ms": max_latency_ms}
 
 
+def bench_serving_v2(n_in: int = 32, hidden: int = 128, n_out: int = 8,
+                     max_batch: int = 16, max_latency_ms: float = 2.0,
+                     concurrency_sweep=(4, 16, 48),
+                     duration_s: float = 3.0,
+                     naive_buckets=(8, 16, 32, 64, 128)) -> dict:
+    """Serving v2 offered-load sweep: 3 registered models (2 dense + 1
+    GravesLSTM) behind one ``ModelRegistry``, RNN traffic through
+    device-resident sessions (ONE timestep dispatch per request), and a
+    p99 SLO enforced by admission control — versus the naive
+    single-model/full-sequence baseline that recomputes the whole
+    conversation every request.
+
+    The SLO is calibrated from the unloaded single-step latency (CPU and
+    TPU differ by orders of magnitude), then the sweep offers increasing
+    closed-loop load; the engine sheds past saturation, so the admitted
+    p99 must hold near the target while the naive baseline's per-request
+    cost grows linearly with session length and blows through it.  The
+    stdout line reports the saturating level, admitted-p99-vs-SLO, shed
+    fraction, and the naive baseline's p99 for ``vs_baseline``."""
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import inputs as _inputs
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                        RnnOutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (InferenceEngine, ModelRegistry,
+                                            ServingError)
+
+    def dense(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .list()
+                .layer(DenseLayer(n_out=hidden))
+                .layer(OutputLayer(n_out=n_out))
+                .set_input_type(_inputs.feed_forward(n_in))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def rnn(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .list()
+                .layer(GravesLSTM(n_out=hidden))
+                .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(_inputs.recurrent(n_in, max(naive_buckets)))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x_dense = rng.randn(1, n_in).astype(np.float32)
+    x_step = rng.randn(1, n_in).astype(np.float32)
+
+    # ---- naive baseline: the reference stack under the SAME load ------
+    # One model, one request at a time (``output()`` is not reentrant in
+    # the reference stack, so a lock serializes), and every request
+    # recomputes the FULL conversation history.  Generously bucketed
+    # (shapes pre-warmed, history padded up the ladder) so the baseline
+    # pays NO compiles in the measured loop — only the O(T) recompute
+    # plus head-of-line blocking that sessions + batching eliminate.
+    naive = rnn(21)
+    for tb in naive_buckets:
+        np.asarray(naive.output(np.zeros((1, tb, n_in), np.float32)))
+    naive_clients = (concurrency_sweep[1] if len(concurrency_sweep) > 1
+                     else concurrency_sweep[0])
+    naive_lat: list = []
+    naive_serial = threading.Lock()
+    naive_record = threading.Lock()
+    naive_stop = time.perf_counter() + duration_s
+
+    def naive_client(i):
+        hist = 0
+        while time.perf_counter() < naive_stop:
+            hist = min(hist + 1, max(naive_buckets))
+            tb = next(b for b in naive_buckets if b >= hist)
+            xs = np.zeros((1, tb, n_in), np.float32)
+            t0 = time.perf_counter()
+            with naive_serial:           # one request at a time
+                np.asarray(naive.output(xs))
+            dt = time.perf_counter() - t0
+            with naive_record:
+                naive_lat.append(dt)
+
+    nthreads = [threading.Thread(target=naive_client, args=(i,))
+                for i in range(naive_clients)]
+    for t in nthreads:
+        t.start()
+    for t in nthreads:
+        t.join()
+    naive_lat.sort()
+
+    def pct(lat, p):
+        return (round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2)
+                if lat else None)
+
+    naive_p99 = pct(naive_lat, 0.99)
+    naive_rps = len(naive_lat) / duration_s
+
+    # ---- SLO calibration: unloaded single-step session latency --------
+    cal = InferenceEngine(rnn(22), max_batch_size=max_batch,
+                          timestep_buckets=naive_buckets,
+                          max_latency_ms=max_latency_ms,
+                          name="bench-cal").start()
+    cal_lat = []
+    for i in range(30):
+        t0 = time.perf_counter()
+        cal.predict_session("cal", x_step)
+        cal_lat.append(time.perf_counter() - t0)
+    cal.stop()
+    cal_lat.sort()
+    slo_p99_ms = max(25.0, 8.0 * (pct(cal_lat, 0.50) or 1.0))
+
+    # ---- 3-model registry, RNN sessions, SLO admission ----------------
+    reg = ModelRegistry()
+    engines = {
+        "dense-a": InferenceEngine(dense(23), max_batch_size=max_batch,
+                                   max_latency_ms=max_latency_ms,
+                                   queue_capacity=4 * max_batch,
+                                   name="dense-a", slo_p99_ms=slo_p99_ms),
+        "dense-b": InferenceEngine(dense(24), max_batch_size=max_batch,
+                                   max_latency_ms=max_latency_ms,
+                                   queue_capacity=4 * max_batch,
+                                   name="dense-b", slo_p99_ms=slo_p99_ms),
+        "rnn": InferenceEngine(rnn(25), max_batch_size=max_batch,
+                               timestep_buckets=naive_buckets,
+                               max_latency_ms=max_latency_ms,
+                               queue_capacity=4 * max_batch,
+                               name="rnn", slo_p99_ms=slo_p99_ms),
+    }
+    for name, eng in engines.items():
+        reg.register(name, eng)
+    engines["dense-a"].warmup((n_in,))
+    engines["dense-b"].warmup((n_in,))
+
+    best = {"rps": 0.0}
+    try:
+        for clients in concurrency_sweep:
+            lat: list = []
+            lock = threading.Lock()
+            counts = [0] * clients
+            sheds = [0] * clients
+            stop_at = time.perf_counter() + duration_s
+
+            def client(i):
+                # a third of the clients stream an RNN session each; the
+                # rest split across the two dense tenants
+                names = ("rnn", "dense-a", "dense-b")
+                name = names[i % 3]
+                sid = f"conv-{i}"
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        if name == "rnn":
+                            reg.predict(name, x_step, session=sid)
+                        else:
+                            reg.predict(name, x_dense, timeout=30.0)
+                    except ServingError:
+                        sheds[i] += 1
+                        time.sleep(0.002)       # shed: back off briefly
+                        continue
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+                    counts[i] += 1
+
+            t_start = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            done = sum(counts)
+            lat.sort()
+            level = {"clients": clients, "rps": round(done / elapsed, 1),
+                     "admitted_p99_ms": pct(lat, 0.99),
+                     "shed": sum(sheds),
+                     "shed_fraction": round(
+                         sum(sheds) / max(1, done + sum(sheds)), 3)}
+            print(json.dumps({"metric": "serving_v2_sweep_level",
+                              **level}), file=sys.stderr, flush=True)
+            if level["rps"] > best.get("rps", 0.0):
+                best = level
+    finally:
+        reg.stop_all()
+
+    session_steps = 0.0
+    for _labels, val in monitor.snapshot().get(
+            "serving_session_steps_total", {}).get("values", {}).items():
+        session_steps += val
+    admitted_p99 = best.get("admitted_p99_ms")
+    return {"metric": "serving_v2_multimodel_requests_per_sec",
+            "value": best.get("rps", 0.0), "unit": "requests/sec",
+            "vs_baseline": (round(best.get("rps", 0.0) / naive_rps, 3)
+                            if naive_rps else None),
+            "models": 3, "saturating_clients": best.get("clients"),
+            "slo_p99_ms": round(slo_p99_ms, 2),
+            "admitted_p99_ms": admitted_p99,
+            "held_slo": (admitted_p99 is not None
+                         and admitted_p99 <= 1.5 * slo_p99_ms),
+            "shed_fraction": best.get("shed_fraction"),
+            "session_steps": session_steps,
+            "naive_clients": naive_clients,
+            "naive_fullseq_rps": round(naive_rps, 1),
+            "naive_fullseq_p99_ms": naive_p99,
+            "baseline_missed_slo": (naive_p99 is not None
+                                    and naive_p99 > slo_p99_ms),
+            "max_batch": max_batch, "max_latency_ms": max_latency_ms}
+
+
 def _serving_compile_count() -> float:
     """Total AOT bucket compiles recorded by the monitor registry —
     proves recompiles stay bounded by the warmed bucket count."""
@@ -1297,9 +1508,11 @@ def main() -> None:
               flush=True)
         return
     if "--serve" in sys.argv:
-        # serving mode: ONE stdout line for the serving benchmark
+        # serving mode: TWO stdout lines — the single-model dynamic
+        # batching benchmark, then the v2 multi-model/session/SLO sweep
         # (offered-load sweep levels go to stderr)
         print(json.dumps(bench_serving()), flush=True)
+        print(json.dumps(bench_serving_v2()), flush=True)
         return
     try:
         print(json.dumps(tunnel_probe()), file=sys.stderr, flush=True)

@@ -170,6 +170,7 @@ class _HistogramSeries:
             "p50": self.quantile(0.50, res),
             "p95": self.quantile(0.95, res),
             "p99": self.quantile(0.99, res),
+            "p999": self.quantile(0.999, res),
         }
 
 
@@ -211,7 +212,8 @@ class Histogram(_Metric):
         with self._lock:
             items = sorted((k, s.stats()) for k, s in self._series.items())
         for key, st in items:
-            for q, field in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            for q, field in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
+                             (0.999, "p999")):
                 qkey = key + (("quantile", str(q)),)
                 lines.append(f"{self.name}{_label_str(qkey)} "
                              f"{_fmt(st[field])}")

@@ -1171,6 +1171,29 @@ class ComputationGraph:
             outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs
 
+    def rnn_stateless_step(self, carries, *features):
+        """Explicit-carry streaming step (re-entrant twin of
+        :meth:`rnn_time_step`): advance the given carry dict by the input
+        timesteps and return ``(outs, new_carries)`` without touching the
+        graph's own hidden-state slot — the primitive behind
+        ``serving.SessionCache``'s N-concurrent-sessions-per-model.
+        ``carries=None`` starts from zero state; inputs must be 3-D
+        ``(batch, time, n_in)``; ``outs`` is always a list (one per
+        graph output) and each call is ONE dispatch of the jitted
+        ``cg.advance`` program."""
+        self.init()
+        self._require_carry_support("rnn_stateless_step")
+        xs = tuple(jnp.asarray(f) for f in features)
+        for x in xs:
+            if x.ndim != 3:
+                raise ValueError(
+                    f"rnn_stateless_step expects (batch, time, features) "
+                    f"inputs, got shape {x.shape}")
+        if carries is None:
+            carries = self._init_carries(int(xs[0].shape[0]))
+        return self._advance_fn(self.params, self.net_state, carries,
+                                xs, None)
+
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""
         self._rnn_carries = None

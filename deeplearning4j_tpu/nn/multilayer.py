@@ -1202,6 +1202,31 @@ class MultiLayerNetwork:
         out = np.asarray(out)
         return out[:, -1] if squeeze else out
 
+    def rnn_stateless_step(self, carries, features):
+        """Explicit-carry streaming step (the re-entrant twin of
+        :meth:`rnn_time_step`): advance the given carry pytree by the
+        input timesteps and return ``(out, new_carries)`` WITHOUT
+        touching the model's own hidden-state slot.  ``carries=None``
+        starts from zero state.  This is what lets N concurrent serving
+        sessions share one model instance (``serving.SessionCache``) —
+        state lives with the caller, arrays stay on device, and each
+        call is exactly ONE dispatch of the jitted
+        ``mln.rnn_step`` program.
+
+        3-D ``features`` only (``(batch, time, n_in)``); the session
+        layer owns the 2-D squeeze convention.
+        """
+        self.init()
+        self._require_carry_support("rnn_stateless_step")
+        x = jnp.asarray(features)
+        if x.ndim != 3:
+            raise ValueError(
+                f"rnn_stateless_step expects (batch, time, features), "
+                f"got shape {x.shape}")
+        if carries is None:
+            carries = self._init_carries(int(x.shape[0]))
+        return self._rnn_step_fn(self.params, self.net_state, carries, x)
+
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""
         self._rnn_carries = None

@@ -54,8 +54,12 @@ class NativeModelRunner:
         self._leaf_avals = [jax.ShapeDtypeStruct(np.shape(l),
                                                  np.asarray(l).dtype)
                             for l in leaves]
-        self._buf_ids = [self._client.buffer_from_host(np.asarray(l))
-                         for l in leaves]
+        # host copies survive paging: free_device_buffers() drops the
+        # device residency, ensure_device_buffers() re-uploads these
+        self._host_leaves = [np.asarray(l) for l in leaves]
+        self._leaf_bytes = int(sum(l.nbytes for l in self._host_leaves))
+        self._buf_ids = [self._client.buffer_from_host(l)
+                         for l in self._host_leaves]
         # insertion/access-ordered: oldest-used first, so hitting
         # max_shapes evicts exactly the least-recently-used executable
         self._execs: "OrderedDict[Tuple, int]" = OrderedDict()
@@ -118,11 +122,40 @@ class NativeModelRunner:
         self._execs[key] = exec_id
         return exec_id
 
+    # ------------------------------------------------------------- paging
+    def resident_bytes(self) -> int:
+        """Device bytes currently pinned by this runner's weight/state
+        buffers (0 when paged out)."""
+        return self._leaf_bytes if self._buf_ids else 0
+
+    def free_device_buffers(self) -> int:
+        """Page the weight/state buffers OFF device, keeping executables
+        and host copies (the serving registry's evict primitive).
+        Returns bytes released; ``output()`` after this re-uploads
+        lazily via :meth:`ensure_device_buffers`."""
+        freed = self.resident_bytes()
+        for b in self._buf_ids:
+            try:
+                self._client.buffer_free(b)
+            except Exception:
+                pass
+        self._buf_ids = []
+        return freed
+
+    def ensure_device_buffers(self) -> None:
+        """Re-upload the host weight copies after a page-out (no-op when
+        resident).  Executables are keyed by program, not buffer ids, so
+        nothing recompiles."""
+        if not self._buf_ids:
+            self._buf_ids = [self._client.buffer_from_host(l)
+                             for l in self._host_leaves]
+
     # --------------------------------------------------------------- run
     def output(self, *features) -> np.ndarray:
         """Forward pass via native PJRT execution (reference
         ``MultiLayerNetwork.output:1519`` / ``ComputationGraph.output``
         semantics: inference mode, running BN stats, no dropout)."""
+        self.ensure_device_buffers()
         feats = [np.ascontiguousarray(f) for f in features]
         avals = [jax.ShapeDtypeStruct(f.shape, f.dtype) for f in feats]
         exec_id = self._exec_for(avals)

@@ -1,15 +1,27 @@
-"""Dynamic-batching inference serving (see docs/SERVING.md).
+"""Multi-tenant dynamic-batching inference serving (docs/SERVING.md).
 
 ``InferenceEngine`` coalesces concurrent ``predict()`` calls into
 bucket-shaped batches executed by AOT-compiled per-bucket executables;
 ``BucketPolicy`` owns the (batch, timestep) ladder both the JAX and
-native PJRT backends share.
+native PJRT backends share.  Serving v2 adds ``ModelRegistry``
+(N named models LRU-paged under an HBM budget), ``SessionCache``
+(device-resident per-session RNN state, one dispatch per request),
+``SloAdmissionController`` (p99-target load shedding) and the int8
+weight path in ``serving.quantize``.
 """
 
+from .admission import SloAdmissionController
 from .bucketing import (BucketPolicy, assemble_batch, batch_ladder,
                         pad_rows, pad_time, time_mask)
-from .engine import InferenceEngine, QueueFull, ServingError
+from .engine import InferenceEngine, QueueFull, ServingError, SloShed
+from .quantize import (dequantize_host, dequantize_tree, quantize_leaf,
+                       quantize_tree, tree_nbytes)
+from .registry import ModelRegistry, UnknownModel
+from .sessions import SessionCache, SessionError
 
-__all__ = ["BucketPolicy", "InferenceEngine", "QueueFull", "ServingError",
-           "assemble_batch", "batch_ladder", "pad_rows", "pad_time",
-           "time_mask"]
+__all__ = ["BucketPolicy", "InferenceEngine", "ModelRegistry", "QueueFull",
+           "ServingError", "SessionCache", "SessionError",
+           "SloAdmissionController", "SloShed", "UnknownModel",
+           "assemble_batch", "batch_ladder", "dequantize_host",
+           "dequantize_tree", "pad_rows", "pad_time", "quantize_leaf",
+           "quantize_tree", "time_mask", "tree_nbytes"]

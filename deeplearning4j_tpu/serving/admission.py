@@ -1,0 +1,98 @@
+"""SLO-aware admission control: shed load at a p99 latency target.
+
+PR 2's only overload response was ``QueueFull`` — a *capacity* signal
+that fires long after latency has collapsed: a bounded queue of 128
+requests in front of a 5 ms batcher already carries ~0.6 s of tail
+latency before the first rejection.  Production serving (the TF-Serving
+load-shedding recipe) admits on the *latency* signal instead: when the
+observed p99 crosses the SLO, excess load is shed immediately with a
+distinct status, so admitted requests keep meeting the target and
+clients get an actionable "overloaded, not full" response.
+
+``SloAdmissionController`` keeps a sliding time window of the same
+request latencies that feed the ``serving_request_latency_ms``
+histogram (one deque append per completed request) and sheds while the
+window p99 exceeds ``slo_p99_ms``.  Window semantics — not the
+histogram's lifetime reservoir — are what make shedding self-healing:
+once shed load drains and in-flight requests complete under target,
+old observations age out of the window and admission reopens.  The
+p99 is recomputed at most every ``refresh_s`` (the admission check on
+the submit hot path is otherwise a single float compare).
+
+``min_samples`` guards cold starts: with fewer observations in the
+window than that, everything is admitted (no latency evidence means no
+grounds to shed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class SloAdmissionController:
+    """Shed-decision oracle for one engine's latency SLO."""
+
+    def __init__(self, slo_p99_ms: float, *, window_s: float = 5.0,
+                 min_samples: int = 30, refresh_s: float = 0.05):
+        if slo_p99_ms <= 0:
+            raise ValueError("slo_p99_ms must be > 0")
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.window_s = float(window_s)
+        self.min_samples = int(min_samples)
+        self.refresh_s = float(refresh_s)
+        self._lat: "deque" = deque()     # (t_monotonic, latency_ms)
+        self._lock = threading.Lock()
+        self._cached_p99: Optional[float] = None
+        self._cached_at = float("-inf")
+
+    def observe(self, latency_ms: float) -> None:
+        """Record one completed request's end-to-end latency (the same
+        value the ``serving_request_latency_ms`` histogram sees)."""
+        now = time.monotonic()
+        with self._lock:
+            self._lat.append((now, float(latency_ms)))
+            self._prune_locked(now)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        lat = self._lat
+        while lat and lat[0][0] < horizon:
+            lat.popleft()
+
+    def window_p99(self) -> Optional[float]:
+        """p99 over the sliding window, or None with too few samples.
+        Cached for ``refresh_s`` so submit-path checks stay O(1)."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._cached_at < self.refresh_s:
+                return self._cached_p99
+            self._prune_locked(now)
+            if len(self._lat) < self.min_samples:
+                p99 = None
+            else:
+                values = sorted(v for _, v in self._lat)
+                idx = min(len(values) - 1, int(0.99 * (len(values) - 1)
+                                               + 0.999999))
+                p99 = values[idx]
+            self._cached_p99 = p99
+            self._cached_at = now
+            return p99
+
+    def should_shed(self) -> Optional[float]:
+        """The observed window p99 when it exceeds the SLO (the shed
+        signal, reported back to the client), else None (admit)."""
+        p99 = self.window_p99()
+        if p99 is not None and p99 > self.slo_p99_ms:
+            return p99
+        return None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = len(self._lat)
+        return {"slo_p99_ms": self.slo_p99_ms,
+                "window_s": self.window_s,
+                "window_samples": n,
+                "window_p99_ms": self._cached_p99}

@@ -27,6 +27,26 @@ shape.  This engine does the standard fix end to end:
 5. Results are unpadded and routed back to per-request futures; a
    worker pool shards buckets across ``jax.devices()``.
 
+Serving v2 adds the multi-tenant machinery (docs/SERVING.md):
+
+- **SLO-aware admission** (``slo_p99_ms=``): requests are shed with
+  :class:`SloShed` while the sliding-window p99 of
+  ``serving_request_latency_ms`` exceeds the target — the latency
+  signal, distinct from ``QueueFull``'s capacity signal, each with its
+  own counter (``serving_shed_total`` vs ``serving_rejected_total``).
+- **int8 weights** (``quantize="int8"``): resident params are
+  per-tensor affine uint8 (``serving.quantize``, the PR-3 wire-decode
+  expression) decoded inside the bucket executable — ~4x fewer
+  resident bytes per model, so the registry pager fits more models.
+- **Device paging** (``release_device_buffers``/``ensure_resident``):
+  the per-worker placed weight buffers can be dropped and re-placed,
+  which is what ``serving.registry.ModelRegistry`` drives LRU-style
+  under an HBM budget.
+- **Session state** (``predict_session``): per-session RNN carries
+  cached on device (``serving.sessions.SessionCache``) so streaming
+  traffic pays ONE single-timestep dispatch per request instead of
+  full-sequence recompute.
+
 The ``NativeModelRunner`` PJRT path is available as
 ``backend="native"``: same bucketer (the ladder bounds the runner's
 per-shape executable cache), execution through the C++ PJRT client.
@@ -34,21 +54,25 @@ per-shape executable cache), execution through the C++ PJRT client.
 Everything is instrumented through the ``monitor`` registry:
 ``serving_queue_depth``, ``serving_batch_fill_ratio``,
 ``serving_padding_waste_ratio`` and ``serving_request_latency_ms``
-(reservoir p50/p95/p99) all export through ``GET /metrics``.
+(reservoir p50/p95/p99/p999, labelled per model) all export through
+``GET /metrics``.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import monitor as _monitor
+from .admission import SloAdmissionController
 from .bucketing import BucketPolicy, assemble_batch
 
 
@@ -58,7 +82,26 @@ class ServingError(RuntimeError):
 
 class QueueFull(ServingError):
     """Raised by non-blocking submits when the request queue is at
-    capacity (the backpressure signal)."""
+    capacity (the backpressure signal).  ``retry_after_s`` carries the
+    drain-rate-derived wait the HTTP layer turns into a ``Retry-After``
+    header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class SloShed(ServingError):
+    """Raised when admission control sheds the request: the engine's
+    observed p99 latency exceeds its SLO target.  Distinct from
+    :class:`QueueFull` — the queue may have room; admitting more load
+    would break the latency target for everyone already admitted."""
+
+    def __init__(self, msg: str, slo_p99_ms: float,
+                 observed_p99_ms: float):
+        super().__init__(msg)
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.observed_p99_ms = float(observed_p99_ms)
 
 
 class _Request:
@@ -97,14 +140,20 @@ class InferenceEngine:
     (callers block past it); ``timestep_buckets`` enables sequence
     padding; ``num_workers``/``devices`` shard buckets across
     accelerators; ``backend="native"`` serves through the C++ PJRT
-    client.
+    client; ``slo_p99_ms`` enables SLO-aware load shedding;
+    ``quantize="int8"`` serves affine-quantized uint8 weights;
+    ``session_ttl_s``/``max_sessions`` configure the device-resident
+    RNN session cache behind :meth:`predict_session`.
     """
 
     def __init__(self, model, *, max_batch_size: int = 32,
                  max_latency_ms: float = 5.0, queue_capacity: int = 128,
                  timestep_buckets: Optional[Sequence[int]] = None,
                  num_workers: int = 1, devices=None,
-                 backend: str = "aot", dtype=None, name: str = "default"):
+                 backend: str = "aot", dtype=None, name: str = "default",
+                 slo_p99_ms: Optional[float] = None,
+                 quantize: Optional[str] = None,
+                 session_ttl_s: float = 300.0, max_sessions: int = 1024):
         from ..nn.computation_graph import ComputationGraph
         model.init()
         self._model = model
@@ -118,7 +167,23 @@ class InferenceEngine:
                                else model.conf.conf.dtype)
         if backend not in ("aot", "native"):
             raise ValueError("backend must be 'aot' or 'native'")
+        if quantize not in (None, "int8"):
+            raise ValueError("quantize must be None or 'int8'")
+        if quantize and backend == "native":
+            raise ValueError(
+                "quantize='int8' requires backend='aot' (the native "
+                "runner uploads the model's own buffers)")
         self._backend = backend
+        self._quantize = quantize
+        self._qjit = None
+        self._qparams = None
+        if quantize == "int8":
+            from . import quantize as _quant
+            self._qparams, self._qspecs = _quant.quantize_tree(
+                model.params)
+            self._qjit = _quant.quantized_output_jit(
+                model, self._qspecs,
+                name=("cg" if self._is_graph else "mln") + ".output_int8")
         self._runner = None
         if backend == "native":
             if self._policy.timestep_buckets:
@@ -141,15 +206,46 @@ class InferenceEngine:
         self._dispatch_q: "queue.Queue" = queue.Queue(maxsize=2 * n_workers)
         self._compiled: dict = {}        # (worker_idx, bucket_key) -> fn
         self._placed: list = [None] * n_workers
+        self._placed_lock = threading.Lock()
         self._compile_lock = threading.Lock()
         self._running = False
         self._threads: List[threading.Thread] = []
+        self._admission = (SloAdmissionController(slo_p99_ms)
+                           if slo_p99_ms else None)
+        self._sessions = None
+        self._session_opts = {"ttl_s": float(session_ttl_s),
+                              "max_sessions": int(max_sessions)}
+        self._session_lock = threading.Lock()
+        # completion timestamps for the queue drain rate (Retry-After)
+        self._done_times: "deque" = deque(maxlen=512)
+        from .quantize import tree_nbytes
+        self._model_bytes = tree_nbytes(
+            (self._qparams, model.net_state) if self._quantize
+            else (model.params, model.net_state))
+
+    # ----------------------------------------------------------- identity
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def slo_p99_ms(self) -> Optional[float]:
+        return self._admission.slo_p99_ms if self._admission else None
 
     # ------------------------------------------------------------ metrics
     def _observe_queue_depth(self):
         _monitor.gauge("serving_queue_depth",
                        "admitted requests waiting to be batched").set(
             self._queue.qsize(), engine=self._name)
+
+    def _observe_latency(self, latency_ms: float) -> None:
+        _monitor.histogram(
+            "serving_request_latency_ms",
+            "end-to-end request latency (enqueue -> result), per model"
+        ).observe(latency_ms, model=self._name)
+        if self._admission is not None:
+            self._admission.observe(latency_ms)
+        self._done_times.append(time.monotonic())
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "InferenceEngine":
@@ -198,19 +294,62 @@ class InferenceEngine:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # ---------------------------------------------------------- admission
+    def _admit_or_shed(self) -> None:
+        if self._admission is None:
+            return
+        observed = self._admission.should_shed()
+        if observed is not None:
+            _monitor.counter(
+                "serving_shed_total",
+                "requests shed by SLO admission control "
+                "(p99 over target)").inc(engine=self._name)
+            raise SloShed(
+                f"shedding: observed p99 {observed:.1f} ms exceeds the "
+                f"{self._admission.slo_p99_ms:.1f} ms SLO; retry with "
+                "backoff", self._admission.slo_p99_ms, observed)
+
+    def drain_rate(self) -> float:
+        """Completed requests per second over the recent completion
+        window (0.0 with no evidence)."""
+        done = list(self._done_times)
+        if len(done) < 2:
+            return 0.0
+        span = done[-1] - done[0]
+        if span <= 0:
+            return 0.0
+        return (len(done) - 1) / span
+
+    def retry_after_s(self) -> float:
+        """Suggested client wait before retrying a rejected request:
+        current queue depth over the observed drain rate, clamped to
+        [1, 60] s (the 429 ``Retry-After`` header value)."""
+        rate = self.drain_rate()
+        depth = max(1, self._queue.qsize())
+        if rate <= 0:
+            return 1.0
+        return float(min(60.0, max(1.0, math.ceil(depth / rate))))
+
     # ------------------------------------------------------------- submit
-    def predict(self, features, timeout: Optional[float] = None):
+    def predict(self, features, timeout: Optional[float] = None,
+                block: bool = True):
         """Blocking inference: enqueue, coalesce, return this request's
-        rows (thread-safe; the engine batches concurrent callers)."""
-        return self.predict_async(features).result(timeout)
+        rows (thread-safe; the engine batches concurrent callers).
+        ``block=False`` rejects with ``QueueFull`` instead of waiting
+        for queue space — the HTTP front end's policy, where the
+        bounded queue IS the buffer and saturation must 429."""
+        return self.predict_async(features, block=block).result(timeout)
 
     def predict_async(self, features, block: bool = True,
                       timeout: Optional[float] = None) -> Future:
         """Enqueue and return a ``Future``.  With ``block=False`` (or a
         ``timeout``) a full queue raises ``QueueFull`` instead of
-        blocking — the explicit backpressure signal."""
+        blocking — the explicit backpressure signal.  With an SLO
+        configured, overload sheds with :class:`SloShed` regardless of
+        queue room."""
         if not self._running:
             raise ServingError("engine not started (call start())")
+        self._admit_or_shed()
         arrays = self._canonicalize(features)
         sig = self._signature(arrays)
         req = _Request(arrays, int(arrays[0].shape[0]), sig)
@@ -223,12 +362,44 @@ class InferenceEngine:
             raise QueueFull(
                 f"serving queue at capacity "
                 f"({self._queue.maxsize}); retry or raise "
-                f"queue_capacity") from None
+                f"queue_capacity", self.retry_after_s()) from None
         _monitor.counter("serving_requests_total",
                          "requests admitted to the serving queue").inc(
             engine=self._name)
         self._observe_queue_depth()
         return req.future
+
+    # ------------------------------------------------------------ sessions
+    @property
+    def sessions(self):
+        """The engine's :class:`~deeplearning4j_tpu.serving.sessions.
+        SessionCache` (created on first use; raises for models without
+        carry support)."""
+        with self._session_lock:
+            if self._sessions is None:
+                from .sessions import SessionCache
+                self._sessions = SessionCache(
+                    self._model, name=self._name, **self._session_opts)
+            return self._sessions
+
+    def predict_session(self, session_id: str, features):
+        """Streaming inference: advance ``session_id``'s device-resident
+        RNN state by the given timesteps (ONE dispatch) and return the
+        output.  Subject to the same SLO admission as ``predict``; not
+        queued/coalesced — session state is a chain, so each session
+        serializes its own steps while distinct sessions run
+        concurrently."""
+        if not self._running:
+            raise ServingError("engine not started (call start())")
+        self._admit_or_shed()
+        t0 = time.perf_counter()
+        out = self.sessions.step(session_id, features,
+                                 dtype=self._dtype)
+        _monitor.counter("serving_requests_total",
+                         "requests admitted to the serving queue").inc(
+            engine=self._name)
+        self._observe_latency((time.perf_counter() - t0) * 1000.0)
+        return out
 
     # ------------------------------------------------------------- warmup
     def warmup(self, example_shape) -> int:
@@ -266,9 +437,56 @@ class InferenceEngine:
                         n += 1
         return n
 
+    # ------------------------------------------------------------- paging
+    def model_bytes(self) -> int:
+        """Device bytes ONE worker's resident copy of this model costs
+        (params + state; the uint8 tree when ``quantize="int8"``) — the
+        registry pager's accounting unit."""
+        return self._model_bytes
+
+    def resident_bytes(self) -> int:
+        """Currently-placed device bytes across workers (0 when paged
+        out)."""
+        with self._placed_lock:
+            if self._backend == "native":
+                return (self._runner.resident_bytes()
+                        if self._runner is not None else 0)
+            return self._model_bytes * sum(
+                1 for p in self._placed if p is not None)
+
+    def is_resident(self) -> bool:
+        return self.resident_bytes() > 0
+
+    def ensure_resident(self) -> int:
+        """Page this model's weights onto every worker device (no-op
+        when already there).  Returns resident bytes."""
+        if self._backend == "native":
+            self._runner.ensure_device_buffers()
+            return self.resident_bytes()
+        for widx in range(len(self._devices)):
+            self._placed_params(widx)
+        return self.resident_bytes()
+
+    def release_device_buffers(self) -> int:
+        """Drop every worker's placed weight buffers (the pager's evict
+        primitive).  Compiled bucket executables survive — they take
+        the weights as call operands, so the next ``ensure_resident``
+        (or lazy ``_placed_params``) page-in reuses them without any
+        recompilation.  Returns bytes released."""
+        with self._placed_lock:
+            if self._backend == "native":
+                return (self._runner.free_device_buffers()
+                        if self._runner is not None else 0)
+            freed = self._model_bytes * sum(
+                1 for p in self._placed if p is not None)
+            # in-flight dispatches hold their own references; dropping
+            # ours lets the device free the buffers once they finish
+            self._placed = [None] * len(self._placed)
+            return freed
+
     # ------------------------------------------------------- introspection
     def stats(self) -> dict:
-        return {
+        d = {
             "running": self._running,
             "queue_depth": self._queue.qsize(),
             "queue_capacity": self._queue.maxsize,
@@ -276,9 +494,18 @@ class InferenceEngine:
             "workers": len(self._devices),
             "devices": [str(d) for d in self._devices],
             "backend": self._backend,
+            "quantize": self._quantize,
             "batch_buckets": list(self._policy.batch_buckets),
             "timestep_buckets": list(self._policy.timestep_buckets),
+            "model_bytes": self._model_bytes,
+            "resident_bytes": self.resident_bytes(),
+            "drain_rate_rps": round(self.drain_rate(), 2),
         }
+        if self._admission is not None:
+            d["admission"] = self._admission.snapshot()
+        if self._sessions is not None:
+            d["sessions"] = self._sessions.stats()
+        return d
 
     def bucket_keys(self):
         """Warmed (signature, batch_bucket) keys (all workers)."""
@@ -323,14 +550,16 @@ class InferenceEngine:
         return tuple(sig)
 
     def _placed_params(self, widx: int):
-        placed = self._placed[widx]
-        if placed is None:
-            import jax
-            placed = jax.device_put(
-                (self._model.params, self._model.net_state),
-                self._devices[widx])
-            self._placed[widx] = placed
-        return placed
+        with self._placed_lock:
+            placed = self._placed[widx]
+            if placed is None:
+                import jax
+                src = ((self._qparams, self._model.net_state)
+                       if self._quantize
+                       else (self._model.params, self._model.net_state))
+                placed = jax.device_put(src, self._devices[widx])
+                self._placed[widx] = placed
+            return placed
 
     def _ensure_executable(self, widx: int, key) -> bool:
         """Compile the bucket executable for (worker, key) if missing.
@@ -351,7 +580,11 @@ class InferenceEngine:
                 else:
                     feature_shapes.append((bb,) + trailing)
                     mask_shapes.append(None)
-            if self._is_graph:
+            if self._quantize:
+                fn = self._compile_quantized(
+                    params, state, feature_shapes,
+                    mask_shapes if any_mask else None)
+            elif self._is_graph:
                 fn = self._model.compile_output(
                     feature_shapes, dtype=self._dtype,
                     mask_shapes=tuple(mask_shapes) if any_mask else None,
@@ -370,6 +603,28 @@ class InferenceEngine:
                 "live AOT bucket executables").set(
                 len(self._compiled), engine=self._name)
             return True
+
+    def _compile_quantized(self, qparams, state, feature_shapes,
+                           mask_shapes):
+        """AOT-compile the decode+forward program for one bucket: same
+        lowering contract as ``compile_output`` but against the uint8
+        params tree (the decode fuses into the consuming matmul/conv)."""
+        import jax
+        dt = np.dtype(self._dtype)
+        avals = tuple(jax.ShapeDtypeStruct(tuple(int(d) for d in s), dt)
+                      for s in feature_shapes)
+        mavals = None
+        if mask_shapes is not None:
+            mavals = tuple(
+                None if s is None
+                else jax.ShapeDtypeStruct(tuple(int(d) for d in s), dt)
+                for s in mask_shapes)
+        if self._is_graph:
+            return self._qjit.lower(qparams, state, avals,
+                                    mavals).compile()
+        return self._qjit.lower(qparams, state, avals[0],
+                                None if mavals is None
+                                else mavals[0]).compile()
 
     def _batcher_loop(self):
         pending = None
@@ -466,15 +721,12 @@ class InferenceEngine:
             engine=self._name)
         _monitor.histogram(
             "serving_batch_fill_ratio",
-            "real rows / bucket rows per dispatched batch").observe(
-            job.rows / bb, engine=self._name)
+            "real rows / bucket rows per dispatched batch, per model"
+        ).observe(job.rows / bb, model=self._name)
         _monitor.histogram(
             "serving_padding_waste_ratio",
-            "padded elements carrying no real data, per batch").observe(
-            float(np.mean(wastes)), engine=self._name)
-        lat = _monitor.histogram(
-            "serving_request_latency_ms",
-            "end-to-end request latency (enqueue -> result)")
+            "padded elements carrying no real data, per batch, per model"
+        ).observe(float(np.mean(wastes)), model=self._name)
         # time-unpad is only unambiguous with a single sequence input
         # (seq-to-seq outputs carry its time axis at the bucket length)
         seq_inputs = [i for i, (kind, _, _) in enumerate(job.sig)
@@ -491,5 +743,5 @@ class InferenceEngine:
                           if o.ndim >= 3 and o.shape[1] == tb else o
                           for o in sl]
             r.future.set_result(sl[0] if len(sl) == 1 else sl)
-            lat.observe((now - r.t_enqueue) * 1000.0, engine=self._name)
+            self._observe_latency((now - r.t_enqueue) * 1000.0)
             off += r.n_rows

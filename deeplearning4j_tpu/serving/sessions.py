@@ -1,0 +1,217 @@
+"""Device-resident per-session RNN state for streaming inference.
+
+PR 2's engine serves recurrent traffic by full-sequence recompute:
+every request re-runs the whole conversation/series from t=0, so
+request cost grows linearly with session length and a T-step session
+pays O(T^2) total work.  The containers already have the O(1) primitive
+— ``rnn_time_step`` (reference ``MultiLayerNetwork.rnnTimeStep:2230``)
+carries hidden state between calls — but as a single mutable slot per
+model instance it cannot serve concurrent sessions.
+
+``SessionCache`` lifts that primitive to N concurrent sessions: each
+session id owns a carry pytree that **stays on device** between
+requests (the arrays returned by the jitted step are never fetched), so
+a streaming request pays exactly ONE single-timestep dispatch — no
+host round-trip for state, no recompute of the prefix.  The step runs
+through the containers' ``rnn_stateless_step`` (explicit carries
+in/out, jitted once per shape through the compile-watch), so the
+one-dispatch-per-request claim is *asserted* by counting
+``jit_compiles_total + jit_cache_hits_total`` for the step fn in
+``tests/test_serving_sessions.py``.
+
+Eviction (both counted in ``serving_session_evictions_total``):
+
+- **TTL**: sessions idle longer than ``ttl_s`` are dropped on the next
+  cache operation (abandoned conversations must not pin HBM forever);
+- **capacity**: at ``max_sessions`` the least-recently-used session is
+  dropped first — the ``NativeModelRunner._execs`` LRU pattern applied
+  to session state.
+
+Thread safety: the cache map has its own lock; each session serializes
+its steps on a per-session lock (state is a chain — two concurrent
+steps for one session would fork it) while distinct sessions dispatch
+concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from .. import monitor as _monitor
+
+
+class SessionError(RuntimeError):
+    """Session-path failures (unknown/expired ids are NOT errors — a new
+    carry is initialized; batch-size mismatches and unsupported models
+    are)."""
+
+
+class _Session:
+    __slots__ = ("carries", "batch", "last_used", "lock", "steps")
+
+    def __init__(self, carries, batch: int):
+        self.carries = carries
+        self.batch = batch
+        self.last_used = time.monotonic()
+        self.lock = threading.Lock()
+        self.steps = 0
+
+
+class SessionCache:
+    """Per-session device-resident RNN carries for one model.
+
+    >>> cache = SessionCache(model, ttl_s=300.0, max_sessions=1024)
+    >>> y0 = cache.step("sess-1", x_t0)     # one timestep, one dispatch
+    >>> y1 = cache.step("sess-1", x_t1)     # carries stayed on device
+    >>> cache.clear("sess-1")               # end of conversation
+    """
+
+    def __init__(self, model, *, ttl_s: float = 300.0,
+                 max_sessions: int = 1024, name: str = "default"):
+        from ..nn.computation_graph import ComputationGraph
+        model.init()
+        model._require_carry_support("SessionCache")
+        self._model = model
+        self._is_graph = isinstance(model, ComputationGraph)
+        self._ttl_s = float(ttl_s)
+        self._max_sessions = int(max_sessions)
+        if self._max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        self._name = str(name)
+        self._sessions: "OrderedDict[str, _Session]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- metrics
+    def _observe_active(self) -> None:
+        _monitor.gauge("serving_sessions_active",
+                       "live device-resident RNN sessions").set(
+            len(self._sessions), model=self._name)
+
+    def _count_eviction(self, reason: str) -> None:
+        _monitor.counter("serving_session_evictions_total",
+                         "sessions evicted from the device cache").inc(
+            model=self._name, reason=reason)
+
+    # ------------------------------------------------------------ stepping
+    def step(self, session_id: str, features,
+             dtype=None) -> np.ndarray:
+        """Advance ``session_id`` by the given timesteps and return the
+        output for exactly those steps.
+
+        2-D input ``(batch, features)`` is one timestep and returns
+        ``(batch, n_out)``; 3-D ``(batch, time, features)`` advances by
+        a chunk and returns ``(batch, time, n_out)``.  Unknown session
+        ids start from zero state.  A batch-size change mid-session
+        raises (reference ``rnnTimeStep`` semantics) — call
+        :meth:`clear` between unrelated sequences.
+        """
+        if self._is_graph:
+            feats = (tuple(features) if isinstance(features, (list, tuple))
+                     else (features,))
+            arrays = tuple(np.asarray(f, dtype=dtype) for f in feats)
+            batch = int(arrays[0].shape[0])
+            squeeze = arrays[0].ndim == 2
+            if squeeze:   # (batch, feat) = one timestep
+                arrays = tuple(a[:, None, :] if a.ndim == 2 else a
+                               for a in arrays)
+        else:
+            x = np.asarray(features, dtype=dtype)
+            batch = int(x.shape[0])
+            squeeze = x.ndim == 2
+            if squeeze:   # (batch, feat) = one timestep
+                x = x[:, None, :]
+        sess = self._acquire(session_id, batch)
+        with sess.lock:
+            if sess.batch != batch:
+                raise SessionError(
+                    f"session {session_id!r} holds state for batch size "
+                    f"{sess.batch}, got {batch}; clear() the session "
+                    "between unrelated sequences")
+            # ONE dispatch: explicit-carry step, carries stay on device
+            if self._is_graph:
+                outs, sess.carries = self._model.rnn_stateless_step(
+                    sess.carries, *arrays)
+                out = outs[0] if len(outs) == 1 else outs
+            else:
+                out, sess.carries = self._model.rnn_stateless_step(
+                    sess.carries, x)
+            sess.steps += 1
+            sess.last_used = time.monotonic()
+        _monitor.counter("serving_session_steps_total",
+                         "single-dispatch session timesteps served").inc(
+            model=self._name)
+        if isinstance(out, list):
+            out = [np.asarray(o) for o in out]
+            return [o[:, -1] if squeeze and o.ndim == 3 else o
+                    for o in out]
+        out = np.asarray(out)
+        return out[:, -1] if squeeze and out.ndim == 3 else out
+
+    def _acquire(self, session_id: str, batch: int) -> _Session:
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                while len(self._sessions) >= self._max_sessions:
+                    self._sessions.popitem(last=False)   # LRU out
+                    self._count_eviction("capacity")
+                carries = self._model._init_carries(batch)
+                sess = self._sessions[session_id] = _Session(carries,
+                                                             batch)
+            else:
+                self._sessions.move_to_end(session_id)   # LRU touch
+            self._observe_active()
+            return sess
+
+    def _sweep_locked(self, now: float) -> None:
+        if self._ttl_s <= 0:
+            return
+        dead = [sid for sid, s in self._sessions.items()
+                if now - s.last_used > self._ttl_s]
+        for sid in dead:
+            del self._sessions[sid]
+            self._count_eviction("ttl")
+
+    # ---------------------------------------------------------- management
+    def clear(self, session_id: str) -> bool:
+        """Drop one session's device state (end of conversation)."""
+        with self._lock:
+            gone = self._sessions.pop(session_id, None) is not None
+            self._observe_active()
+        return gone
+
+    def clear_all(self) -> None:
+        with self._lock:
+            self._sessions.clear()
+            self._observe_active()
+
+    def get_carries(self, session_id: str):
+        """The session's carry pytree (device arrays), or None —
+        ``rnn_get_previous_state`` lifted to named sessions."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return None if sess is None else sess.carries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "sessions": len(self._sessions),
+                "max_sessions": self._max_sessions,
+                "ttl_s": self._ttl_s,
+                "oldest_idle_s": round(
+                    max((now - s.last_used for s in
+                         self._sessions.values()), default=0.0), 3),
+                "total_steps": sum(s.steps
+                                   for s in self._sessions.values()),
+            }

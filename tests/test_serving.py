@@ -302,3 +302,201 @@ def test_http_predict_errors():
             assert e.value.code == 400
     finally:
         srv.stop()
+
+
+# ---- SLO-aware admission control -----------------------------------------
+
+def test_admission_controller_sheds_and_self_heals():
+    """Window semantics: p99 over target sheds; once hot samples age out
+    of the sliding window, admission reopens (the lifetime histogram
+    would stay poisoned forever)."""
+    import time
+
+    from deeplearning4j_tpu.serving import SloAdmissionController
+    ctl = SloAdmissionController(10.0, window_s=0.2, min_samples=5,
+                                 refresh_s=0.0)
+    # cold start: no evidence, everything admitted
+    assert ctl.should_shed() is None
+    for _ in range(20):
+        ctl.observe(50.0)                 # 5x over the 10 ms SLO
+    assert ctl.should_shed() is not None
+    assert ctl.snapshot()["window_p99_ms"] > 10.0
+    time.sleep(0.3)                       # hot samples age out
+    assert ctl.should_shed() is None
+
+
+def test_engine_sheds_with_distinct_metric_and_slo_payload():
+    from deeplearning4j_tpu.serving import SloShed
+
+    def _shed_total():
+        vals = monitor.snapshot().get("serving_shed_total",
+                                      {}).get("values", {})
+        return sum(vals.values())
+
+    model = _dense_model()
+    rng = np.random.RandomState(11)
+    with InferenceEngine(model, max_batch_size=4, max_latency_ms=1.0,
+                         name="slo-eng", slo_p99_ms=0.0001) as eng:
+        eng.warmup((4,))
+        before = _shed_total()
+        shed = None
+        for _ in range(200):
+            try:
+                eng.predict(rng.randn(1, 4), timeout=30.0)
+            except SloShed as e:
+                shed = e
+                break
+        assert shed is not None, "engine never shed under impossible SLO"
+        assert shed.slo_p99_ms == 0.0001
+        assert shed.observed_p99_ms > shed.slo_p99_ms
+        assert _shed_total() > before
+
+
+def test_queue_full_carries_retry_after():
+    model = _dense_model()
+    eng = InferenceEngine(model, max_batch_size=2, queue_capacity=2,
+                          max_latency_ms=1000.0, name="retry")
+    eng._running = True           # accept submits without starting threads
+    try:
+        x = np.zeros((1, 4))
+        for _ in range(2):
+            eng.predict_async(x, block=False)
+        with pytest.raises(QueueFull) as e:
+            eng.predict_async(x, block=False)
+        assert 1.0 <= e.value.retry_after_s <= 60.0
+    finally:
+        eng._running = False
+
+
+# ---- per-model labels and p999 -------------------------------------------
+
+def test_latency_metric_labeled_per_model_with_p999():
+    model = _dense_model()
+    with InferenceEngine(model, max_batch_size=4, max_latency_ms=1.0,
+                         name="labeled-model") as eng:
+        eng.warmup((4,))
+        eng.predict(np.random.RandomState(12).randn(2, 4), timeout=60.0)
+    snap = monitor.snapshot()
+    lat = snap.get("serving_request_latency_ms", {}).get("values", {})
+    key = 'model="labeled-model"'
+    assert any(key in k for k in lat)
+    stats = next(v for k, v in lat.items() if key in k)
+    assert "p999" in stats and stats["p999"] >= stats["p99"] >= 0
+    for metric in ("serving_batch_fill_ratio",
+                   "serving_padding_waste_ratio"):
+        vals = snap.get(metric, {}).get("values", {})
+        assert any(key in k for k in vals), metric
+    # the exposition format shows the 0.999 quantile row
+    txt = monitor.prometheus_text()
+    assert 'quantile="0.999"' in txt
+
+
+# ---- HTTP: registry routing, /models, Retry-After, shed payload ----------
+
+def test_http_registry_routing_and_models_endpoint():
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.ui.server import UIServer
+    model_a = _dense_model(seed=31)
+    model_b = _rnn_model(seed=32)
+    reg = ModelRegistry()
+    srv = UIServer(port=0).start()
+    try:
+        reg.register("dense", InferenceEngine(
+            model_a, max_batch_size=4, max_latency_ms=1.0, name="dense"))
+        reg.register("rnn", InferenceEngine(
+            model_b, max_batch_size=4, timestep_buckets=(4, 8),
+            max_latency_ms=1.0, name="rnn"))
+        srv.attach_registry(reg)
+        base = "http://127.0.0.1:%d" % srv.port
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/predict", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=60)
+                              .read())
+
+        x = np.random.RandomState(13).randn(2, 4)
+        body = post({"model": "dense", "features": x.tolist()})
+        np.testing.assert_allclose(np.asarray(body["output"]),
+                                   np.asarray(model_a.output(x)),
+                                   atol=1e-6)
+        # session routing: two single-step calls chain device state
+        ref = _rnn_model(seed=32)
+        xs = np.random.RandomState(14).randn(1, 2, 3)
+        o0 = post({"model": "rnn", "session": "conv-9",
+                   "features": xs[:, 0].tolist()})
+        o1 = post({"model": "rnn", "session": "conv-9",
+                   "features": xs[:, 1].tolist()})
+        full = np.asarray(ref.output(xs))
+        np.testing.assert_allclose(
+            np.stack([np.asarray(o0["output"]),
+                      np.asarray(o1["output"])], axis=1),
+            full, atol=1e-12)
+        # unknown model -> 404 with the hosted list
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"model": "nope", "features": x.tolist()})
+        assert e.value.code == 404
+        assert "dense" in json.loads(e.value.read())["models"]
+        # /models hosting view
+        models = json.loads(urllib.request.urlopen(
+            base + "/models", timeout=30).read())
+        assert set(models["models"]) == {"dense", "rnn"}
+        assert models["models"]["dense"]["resident"] is True
+    finally:
+        srv.stop()
+        reg.stop_all()
+
+
+def test_http_429_has_retry_after_header():
+    from deeplearning4j_tpu.ui.server import UIServer
+    model = _dense_model()
+    eng = InferenceEngine(model, max_batch_size=2, queue_capacity=1,
+                          max_latency_ms=1000.0, name="h429")
+    eng._running = True           # stalled engine: queue fills instantly
+    srv = UIServer(port=0).start()
+    try:
+        srv.attach_inference(eng)
+        url = "http://127.0.0.1:%d/predict" % srv.port
+        eng.predict_async(np.zeros((1, 4)), block=False)   # fill queue
+        req = urllib.request.Request(
+            url, data=json.dumps({"features": [[0.0] * 4]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        assert json.loads(e.value.read())["retry_after_s"] >= 1.0
+    finally:
+        eng._running = False
+        srv.stop()
+
+
+def test_http_shed_503_reports_slo():
+    from deeplearning4j_tpu.ui.server import UIServer
+    model = _dense_model()
+    srv = UIServer(port=0).start()
+    try:
+        with InferenceEngine(model, max_batch_size=4, max_latency_ms=1.0,
+                             name="h503", slo_p99_ms=0.0001) as eng:
+            eng.warmup((4,))
+            srv.attach_inference(eng)
+            url = "http://127.0.0.1:%d/predict" % srv.port
+            payload = json.dumps({"features": [[0.0] * 4]}).encode()
+            shed_body = None
+            for _ in range(200):
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=30)
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    shed_body = json.loads(e.read())
+                    break
+            assert shed_body is not None, "no shed under impossible SLO"
+            assert shed_body["shed"] is True
+            assert shed_body["slo_p99_ms"] == 0.0001
+            assert shed_body["observed_p99_ms"] > 0
+    finally:
+        srv.stop()

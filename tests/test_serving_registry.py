@@ -1,0 +1,225 @@
+"""Multi-model registry + int8 path tests: affine quantize/decode
+round trips (host twin == traceable decode), the measured int8 accuracy
+gate against f32 on the iris eval, LRU weight paging under an HBM byte
+budget with residency/eviction telemetry, and engine paging safety
+(executables survive page-out)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.datasets.iris import iris_dataset
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import (InferenceEngine, ModelRegistry,
+                                        UnknownModel, dequantize_host,
+                                        quantize_leaf, quantize_tree,
+                                        tree_nbytes)
+from deeplearning4j_tpu.serving.quantize import dequantize_tree
+
+
+def _dense_model(n_in=4, n_out=3, hidden=16, seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .list()
+            .layer(DenseLayer(n_out=hidden))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(inputs.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _engine(seed, hidden=8, **kw):
+    kw.setdefault("name", f"m{seed}")
+    return InferenceEngine(_dense_model(hidden=hidden, seed=seed),
+                           max_batch_size=4, max_latency_ms=1.0, **kw)
+
+
+# ---- quantization math ---------------------------------------------------
+
+def test_quantize_leaf_round_trip_error_bound():
+    rng = np.random.RandomState(0)
+    w = rng.randn(32, 16).astype(np.float32) * 3.0
+    q, wf = quantize_leaf(w)
+    assert q.dtype == np.uint8
+    back = wf.decode_host(q)
+    # per-tensor affine: worst-case error is half a quantization step
+    step = (w.max() - w.min()) / 255.0
+    assert float(np.abs(back - w).max()) <= step / 2 + 1e-6
+
+
+def test_quantize_leaf_constant_and_nonfinite():
+    q, wf = quantize_leaf(np.full((8, 8), 2.5, np.float32))
+    np.testing.assert_allclose(wf.decode_host(q), 2.5, atol=1e-6)
+    with pytest.raises(ValueError):
+        quantize_leaf(np.array([[np.nan, 1.0]], np.float32))
+
+
+def test_quantize_tree_policy_and_decode_twins():
+    """Only rank>=2 leaves above the size floor quantize (biases stay
+    f32), and the traceable device decode matches the host twin to a
+    single f32 ulp (XLA may reassociate the affine expression)."""
+    model = _dense_model(hidden=32)
+    qparams, specs = quantize_tree(model.params)
+    import jax
+    leaves = jax.tree.leaves(qparams)
+    assert any(np.asarray(l).dtype == np.uint8 for l in leaves)
+    assert any(np.asarray(l).dtype != np.uint8 for l in leaves)  # biases
+    assert tree_nbytes(qparams) < tree_nbytes(model.params)
+    host = dequantize_host(qparams, specs)
+    dev = jax.jit(lambda t: dequantize_tree(t, specs))(qparams)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(dev)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b, np.asarray(a).dtype),
+            rtol=0, atol=5e-7)
+
+
+# ---- the int8 accuracy gate ----------------------------------------------
+
+def test_int8_matches_f32_top1_on_iris():
+    """The stated tolerance for the int8 path, measured on the full
+    iris eval: top-1 accuracy delta <= 2% vs the f32 engine, top-1
+    agreement >= 97%, softmax outputs within 0.02 absolute."""
+    ds = iris_dataset()
+    model = _dense_model(seed=5)
+    model.fit(ds, epochs=20)
+    twin = _dense_model(seed=5)
+    twin.fit(ds, epochs=20)
+    x = np.asarray(ds.features)
+    labels = np.argmax(np.asarray(ds.labels), axis=1)
+    p32, p8 = [], []
+    with InferenceEngine(model, max_batch_size=32, max_latency_ms=1.0,
+                         name="iris-f32") as e32, \
+         InferenceEngine(twin, max_batch_size=32, max_latency_ms=1.0,
+                         name="iris-i8", quantize="int8") as e8:
+        for i in range(0, len(x), 32):
+            chunk = x[i:i + 32]
+            p32.append(np.asarray(e32.predict(chunk, timeout=60.0)))
+            p8.append(np.asarray(e8.predict(chunk, timeout=60.0)))
+    y32 = np.concatenate(p32)
+    y8 = np.concatenate(p8)
+    acc32 = float(np.mean(np.argmax(y32, 1) == labels))
+    acc8 = float(np.mean(np.argmax(y8, 1) == labels))
+    assert abs(acc32 - acc8) <= 0.02          # the accuracy-delta gate
+    agree = float(np.mean(np.argmax(y32, 1) == np.argmax(y8, 1)))
+    assert agree >= 0.97
+    assert float(np.abs(y32 - y8).max()) < 0.02
+    # the economics: the quantized resident tree is materially smaller
+    assert e8.model_bytes() < 0.7 * e32.model_bytes()
+
+
+# ---- engine paging primitives --------------------------------------------
+
+def test_engine_page_out_and_back_is_lossless_and_compile_free():
+    model = _dense_model()
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 4)
+
+    def compiles():
+        vals = monitor.snapshot().get("serving_bucket_compiles_total",
+                                      {}).get("values", {})
+        return sum(vals.values())
+
+    with InferenceEngine(model, max_batch_size=4, max_latency_ms=1.0,
+                         name="pager") as eng:
+        eng.warmup((4,))
+        ref = np.asarray(eng.predict(x, timeout=60.0))
+        assert eng.is_resident()
+        c0 = compiles()
+        freed = eng.release_device_buffers()
+        assert freed == eng.model_bytes()
+        assert not eng.is_resident()
+        # page back in lazily on the next request: same answer, and the
+        # warmed executables were NOT invalidated by the round trip
+        got = np.asarray(eng.predict(x, timeout=60.0))
+        np.testing.assert_array_equal(got, ref)
+        assert eng.is_resident()
+        assert compiles() == c0
+
+
+# ---- registry ------------------------------------------------------------
+
+def test_registry_unknown_model_and_duplicate():
+    reg = ModelRegistry()
+    reg.register("a", _engine(1))
+    try:
+        with pytest.raises(UnknownModel):
+            reg.get("nope")
+        with pytest.raises(UnknownModel):
+            reg.predict("nope", np.zeros((1, 4)))
+        with pytest.raises(ValueError):
+            reg.register("a", _engine(2))
+    finally:
+        reg.stop_all()
+
+
+def test_registry_lru_pages_under_budget():
+    """3 models under a 2-model budget: registration + traffic must keep
+    resident bytes within budget by evicting exactly the LRU model, and
+    a request for a paged-out model transparently pages it back in."""
+    probe = _engine(99)
+    per_model = probe.model_bytes()
+    probe.stop()
+    budget = 2 * per_model + per_model // 2
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        for s in (1, 2, 3):
+            reg.register(f"m{s}", _engine(s))
+        assert reg.resident_bytes() <= budget
+        st = reg.stats()["models"]
+        assert [st[f"m{s}"]["resident"] for s in (1, 2, 3)] == \
+            [False, True, True]                   # m1 was the LRU
+        rng = np.random.RandomState(2)
+        y = reg.predict("m1", rng.randn(2, 4), timeout=60.0)
+        assert np.asarray(y).shape == (2, 3)
+        st = reg.stats()["models"]
+        assert st["m1"]["resident"]
+        assert not st["m2"]["resident"]           # new LRU paged out
+        assert reg.resident_bytes() <= budget
+        vals = monitor.snapshot().get("serving_model_evictions_total",
+                                      {}).get("values", {})
+        assert sum(vals.values()) >= 2
+        vals = monitor.snapshot().get("serving_model_pageins_total",
+                                      {}).get("values", {})
+        assert sum(vals.values()) >= 4
+    finally:
+        reg.stop_all()
+
+
+def test_registry_pinned_model_survives_pressure():
+    probe = _engine(98)
+    per_model = probe.model_bytes()
+    probe.stop()
+    reg = ModelRegistry(hbm_budget_bytes=per_model + per_model // 2)
+    try:
+        reg.register("pinned", _engine(1), pinned=True)
+        reg.register("b", _engine(2))
+        reg.register("c", _engine(3))
+        st = reg.stats()["models"]
+        assert st["pinned"]["resident"]           # never evicted
+    finally:
+        reg.stop_all()
+
+
+def test_registry_no_budget_keeps_everything_resident():
+    reg = ModelRegistry()
+    try:
+        for s in (1, 2, 3):
+            reg.register(f"m{s}", _engine(s))
+        assert all(v["resident"]
+                   for v in reg.stats()["models"].values())
+        assert len(reg) == 3 and "m2" in reg
+    finally:
+        reg.stop_all()
+
+
+def test_registry_unregister_releases():
+    reg = ModelRegistry()
+    try:
+        eng = reg.register("a", _engine(1))
+        assert eng.is_resident()
+        reg.unregister("a")
+        assert not eng.is_resident()
+        assert "a" not in reg
+    finally:
+        reg.stop_all()
