@@ -37,18 +37,25 @@ from . import health
 from .health import (TrainingDivergedError, disable as disable_health,
                      enable as enable_health, enabled as health_enabled,
                      snapshot as health_snapshot)
+from . import flight_recorder
+from .flight_recorder import incident_dir, record_incident
 from .jit_watch import WatchedJit, publish_cost_analysis, watched_jit
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, registry)
-from .tracing import Tracer, span, tracer
+from .tracing import (TraceContext, Tracer, attach, current_context,
+                      detach, new_trace_id, parse_traceparent, span,
+                      tracer)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
-    "TrainingDivergedError", "WatchedJit", "counter", "disable_health",
-    "enable_health", "gauge", "health", "health_enabled",
-    "health_snapshot", "histogram", "observe_phase", "phase_breakdown",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "TraceContext",
+    "Tracer", "TrainingDivergedError", "WatchedJit", "attach", "counter",
+    "current_context", "detach", "disable_health", "enable_health",
+    "flight_recorder", "gauge", "health", "health_enabled",
+    "health_snapshot", "histogram", "incident_dir", "new_trace_id",
+    "observe_phase", "parse_traceparent", "phase_breakdown",
     "post_system_metrics", "prometheus_text", "publish_cost_analysis",
-    "registry", "reset", "snapshot", "span", "system_metrics_persistable",
-    "trace_jsonl", "tracer", "watched_jit",
+    "record_incident", "registry", "reset", "snapshot", "span",
+    "system_metrics_persistable", "trace_chrome_json", "trace_jsonl",
+    "tracer", "watched_jit",
 ]
 
 # Canonical phase-histogram names: host wall-clock attribution of one
@@ -130,10 +137,18 @@ def prometheus_text() -> str:
     return registry().prometheus_text()
 
 
-def trace_jsonl() -> str:
+def trace_jsonl(trace_id=None, name=None, limit=None) -> str:
     """The ``GET /trace`` body: one Chrome trace event per line (wrap the
-    lines in ``[...]`` to load in Perfetto / chrome://tracing)."""
-    return tracer().to_jsonl()
+    lines in ``[...]`` to load in Perfetto / chrome://tracing).  Filters
+    mirror the endpoint's ``?trace_id=``/``?name=``/``?limit=``."""
+    return tracer().to_jsonl(trace_id=trace_id, name=name, limit=limit)
+
+
+def trace_chrome_json(trace_id=None, name=None, limit=None) -> str:
+    """The ``GET /trace?format=chrome`` body: a ready-to-load JSON array
+    of Chrome trace events."""
+    return tracer().to_chrome_json(trace_id=trace_id, name=name,
+                                   limit=limit)
 
 
 def system_metrics_persistable(model, session_id: str,
@@ -179,3 +194,4 @@ def reset() -> None:
     registry().clear()
     tracer().clear()
     health.reset()
+    flight_recorder.reset_rate_limit()

@@ -351,6 +351,14 @@ def record_dispatch(model, stack, first_iteration: int) -> None:
         msg = (f"training diverged at step {step} (layer {layer}: "
                f"{reason}); {n_bad}/{arr.shape[0]} steps in this "
                f"dispatch flagged, policy={cfg.policy}")
+        # Dump the flight-recorder bundle BEFORE the abort unwinds: the
+        # bundle must capture the spans/metrics as they are at the
+        # moment of divergence (lazy import — flight_recorder imports
+        # this module).
+        from . import flight_recorder as _flight
+        _flight.record_incident("divergence", dict(
+            snap["diverged_at"], policy=cfg.policy,
+            flagged_steps=n_bad, loss=snap["loss"]))
         if cfg.policy == "abort":
             raise TrainingDivergedError(msg, step=step, layer=layer)
         if cfg.policy == "skip_update":

@@ -12,17 +12,38 @@ Two read paths:
   exporter Persistable, ``bench.py``'s phase breakdown, and tests.
 - :meth:`MetricsRegistry.prometheus_text` — the text exposition format
   served at ``GET /metrics`` (histograms render as summaries: quantile
-  series + ``_sum``/``_count``).
+  series + ``_sum``/``_count``, plus classic ``_bucket`` series carrying
+  OpenMetrics *exemplars* — the trace_id of a recent observation that
+  landed in that bucket, so a latency spike on a dashboard is one click
+  from its distributed trace).
+
+Exemplars are captured automatically: when :meth:`Histogram.observe`
+runs under an active trace context (see :mod:`.tracing`), the ambient
+trace_id is recorded against the bucket the value falls in (last
+``EXEMPLARS_PER_BUCKET`` kept per bucket); callers crossing a thread
+boundary can pass ``exemplar="<32-hex trace id>"`` explicitly.
 """
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from .tracing import current_context as _current_trace_context
+
 RESERVOIR_SIZE = 2048
+
+# Log-decade (1 / 2.5 / 5) bucket ladder for the exemplar-bearing classic
+# histogram series.  Units are whatever the histogram observes (our
+# latency histograms observe milliseconds); the +Inf bucket is implicit.
+BUCKET_BOUNDS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+EXEMPLARS_PER_BUCKET = 4
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -134,7 +155,8 @@ class Gauge(_Metric):
 
 
 class _HistogramSeries:
-    __slots__ = ("count", "sum", "min", "max", "reservoir")
+    __slots__ = ("count", "sum", "min", "max", "reservoir", "buckets",
+                 "exemplars")
 
     def __init__(self):
         self.count = 0
@@ -142,8 +164,12 @@ class _HistogramSeries:
         self.min = float("inf")
         self.max = float("-inf")
         self.reservoir = deque(maxlen=RESERVOIR_SIZE)
+        self.buckets = [0] * (len(BUCKET_BOUNDS) + 1)
+        # bucket index -> deque of (trace_id hex, value, unix ts)
+        self.exemplars: Dict[int, deque] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         self.count += 1
         self.sum += value
         if value < self.min:
@@ -151,6 +177,14 @@ class _HistogramSeries:
         if value > self.max:
             self.max = value
         self.reservoir.append(value)
+        idx = bisect.bisect_left(BUCKET_BOUNDS, value)
+        self.buckets[idx] += 1
+        if exemplar:
+            dq = self.exemplars.get(idx)
+            if dq is None:
+                dq = self.exemplars[idx] = deque(
+                    maxlen=EXEMPLARS_PER_BUCKET)
+            dq.append((exemplar, value, time.time()))
 
     def quantile(self, q: float, sorted_res: Optional[List[float]] = None
                  ) -> float:
@@ -162,7 +196,7 @@ class _HistogramSeries:
 
     def stats(self) -> Dict:
         res = sorted(self.reservoir)
-        return {
+        out = {
             "count": self.count,
             "sum": self.sum,
             "min": self.min if self.count else 0.0,
@@ -172,6 +206,12 @@ class _HistogramSeries:
             "p99": self.quantile(0.99, res),
             "p999": self.quantile(0.999, res),
         }
+        if self.exemplars:
+            out["exemplars"] = {
+                _le_str(idx): [{"trace_id": t, "value": v, "ts": ts}
+                               for t, v, ts in dq]
+                for idx, dq in sorted(self.exemplars.items())}
+        return out
 
 
 class Histogram(_Metric):
@@ -185,13 +225,21 @@ class Histogram(_Metric):
         super().__init__(name, help)
         self._series: Dict[LabelKey, _HistogramSeries] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record ``value``.  ``exemplar`` is a 32-hex trace id to pin to
+        the bucket this value lands in; when omitted, the ambient trace
+        context of the calling thread (if any) supplies it."""
+        if exemplar is None:
+            ctx = _current_trace_context()
+            if ctx is not None:
+                exemplar = f"{ctx.trace_id:032x}"
         key = _label_key(labels)
         with self._lock:
             series = self._series.get(key)
             if series is None:
                 series = self._series[key] = _HistogramSeries()
-            series.observe(float(value))
+            series.observe(float(value), exemplar)
 
     def stats(self, **labels) -> Dict:
         with self._lock:
@@ -206,22 +254,48 @@ class Histogram(_Metric):
 
     def prometheus_lines(self) -> List[str]:
         # Exposed in summary form: quantile series + _sum/_count — richer
-        # than fixed buckets for the wall-clock distributions we track.
+        # than fixed buckets for the wall-clock distributions we track —
+        # plus classic cumulative ``_bucket`` series whose lines carry
+        # OpenMetrics exemplars (`... # {trace_id="..."} value ts`) when
+        # observations arrived under a trace context.
         lines = [f"# HELP {self.name} {self.help}".rstrip(),
                  f"# TYPE {self.name} summary"]
         with self._lock:
-            items = sorted((k, s.stats()) for k, s in self._series.items())
-        for key, st in items:
+            items = sorted(
+                ((k, s.stats(), list(s.buckets),
+                  {i: list(dq) for i, dq in s.exemplars.items()})
+                 for k, s in self._series.items()),
+                key=lambda t: t[0])
+        for key, st, buckets, exemplars in items:
             for q, field in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
                              (0.999, "p999")):
                 qkey = key + (("quantile", str(q)),)
                 lines.append(f"{self.name}{_label_str(qkey)} "
                              f"{_fmt(st[field])}")
+            cum = 0
+            for idx, n in enumerate(buckets):
+                cum += n
+                bkey = key + (("le", _le_str(idx)),)
+                line = f"{self.name}_bucket{_label_str(bkey)} {cum}"
+                dq = exemplars.get(idx)
+                if dq:
+                    trace_id, val, ts = dq[-1]
+                    line += (f' # {{trace_id="{trace_id}"}} '
+                             f"{_fmt(val)} {ts:.3f}")
+                lines.append(line)
             lines.append(f"{self.name}_sum{_label_str(key)} "
                          f"{_fmt(st['sum'])}")
             lines.append(f"{self.name}_count{_label_str(key)} "
                          f"{_fmt(st['count'])}")
         return lines
+
+
+def _le_str(bucket_idx: int) -> str:
+    """The ``le`` label value for a bucket index (``"+Inf"`` for the
+    overflow bucket)."""
+    if bucket_idx >= len(BUCKET_BOUNDS):
+        return "+Inf"
+    return _fmt(BUCKET_BOUNDS[bucket_idx])
 
 
 def _fmt(v: float) -> str:

@@ -273,6 +273,8 @@ class ProfilerListener(TrainingListener):
         self.start_iteration = start_iteration
         self.end_iteration = end_iteration
         self._tracing = False
+        self._capture_t0: Optional[float] = None
+        self._capture_ctx = None
         self._last_t: Optional[float] = None
         self.iteration_times_ms: List[float] = []
 
@@ -284,21 +286,51 @@ class ProfilerListener(TrainingListener):
         self._last_t = now
         if not self._tracing and iteration >= self.start_iteration \
                 and iteration < self.end_iteration:
+            from ... import monitor as _monitor
             jax.profiler.start_trace(self.log_dir)
             self._tracing = True
+            self._capture_t0 = time.time()
+            self._capture_ctx = _monitor.current_context()
         elif self._tracing and iteration >= self.end_iteration:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        """Close the capture exactly once.  ``_tracing`` flips before the
+        profiler call and a failed ``stop_trace`` is swallowed: on the
+        error path (e.g. the capture died with the run, or ``stop`` races
+        ``iteration_done``) a second stop must not raise over the
+        original failure.  The capture window is also recorded as a
+        ``profiler/capture`` span so it shows up on the trace timeline
+        next to the work it profiled."""
+        if not self._tracing:
+            return
+        self._tracing = False
+        try:
+            import jax
             jax.profiler.stop_trace()
-            self._tracing = False
+        except RuntimeError:
+            pass
+        if self._capture_t0 is not None:
+            from ... import monitor as _monitor
+            ctx = self._capture_ctx
+            _monitor.tracer().record_span(
+                "profiler/capture",
+                trace_id=(ctx.trace_id if ctx is not None
+                          else _monitor.new_trace_id()),
+                parent_id=ctx.span_id if ctx is not None else None,
+                ts=self._capture_t0,
+                dur_ms=(time.time() - self._capture_t0) * 1e3,
+                log_dir=self.log_dir)
+            self._capture_t0 = None
+            self._capture_ctx = None
 
     def stop(self) -> None:
         """Close a still-open capture (only needed when training ended
         before ``end_iteration``).  Deliberately NOT hooked to epoch
         boundaries — a capture window spanning epochs must stay one
-        contiguous trace."""
-        if self._tracing:
-            import jax
-            jax.profiler.stop_trace()
-            self._tracing = False
+        contiguous trace.  Idempotent: safe on the error path where the
+        capture was already stopped (or never started)."""
+        self._stop_trace()
 
     def phase_report(self) -> dict:
         """Host-side phase timing summary (mean/p50/p95 iteration ms)."""

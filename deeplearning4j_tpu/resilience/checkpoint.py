@@ -145,7 +145,18 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     """Verify ``path`` against its own manifest (entry presence, exact
     sizes, SHA-256) and return the manifest.  Raises
     :class:`CheckpointCorruptError` with a diagnostic naming the first
-    failing entry."""
+    failing entry (after dumping a ``checkpoint_corrupt`` flight-recorder
+    incident bundle — corruption is rare and always worth a
+    post-mortem)."""
+    try:
+        return _verify_checkpoint(path)
+    except CheckpointCorruptError as e:
+        _monitor.record_incident("checkpoint_corrupt",
+                                 {"path": path, "error": str(e)})
+        raise
+
+
+def _verify_checkpoint(path: str) -> Dict[str, Any]:
     try:
         with zipfile.ZipFile(path, "r") as zf:
             names = set(zf.namelist())

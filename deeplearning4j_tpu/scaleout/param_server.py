@@ -27,12 +27,14 @@ from __future__ import annotations
 
 import collections
 import itertools
+import json
+import os
 import random
 import socket
 import struct
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -130,10 +132,16 @@ class TcpParameterServer:
 
     Wire v2 — see the frame helpers above.  Request ops:
     ``P`` (pull: reply payload = f64 param bytes), ``U`` (push delta:
-    idempotent on ``req_id``), ``S`` (stats: u64 push count), ``Q``
-    (close).  A client dying mid-frame costs its own connection only
-    (counted in ``param_server_client_disconnects_total``); the server
-    and every other connection keep serving.
+    idempotent on ``req_id``), ``S`` (stats: u64 push count), ``T``
+    (trace context: payload = W3C ``traceparent``; the NEXT op on this
+    connection records its server-side span under that context, so a
+    worker's push stitches into the worker's distributed trace across
+    the process boundary), ``D`` (trace dump: reply payload = JSON
+    ``{"pid", "events"}`` of this process's span ring — how a test or
+    ``tools/trace_view.py`` merges server-side spans into one timeline),
+    ``Q`` (close).  A client dying mid-frame costs its own connection
+    only (counted in ``param_server_client_disconnects_total``); the
+    server and every other connection keep serving.
     """
 
     #: remembered push req_ids for idempotent retries (per server, FIFO)
@@ -194,7 +202,10 @@ class TcpParameterServer:
             while len(self._seen) > self.DEDUP_WINDOW:
                 self._seen.popitem(last=False)
 
+    _OP_NAMES = {b"P": "pull", b"U": "push", b"S": "stats"}
+
     def _serve_conn(self, conn: socket.socket) -> None:
+        pending_ctx = None  # set by a T frame, consumed by the next op
         try:
             with conn:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -205,25 +216,41 @@ class TcpParameterServer:
                     op, req_id, payload = frame
                     if op == b"Q":
                         return
-                    if op == b"P":
-                        _send_response(conn, b"K",
-                                       self.server.pull().tobytes())
-                    elif op == b"U":
-                        delta = np.frombuffer(payload, np.float64)
-                        try:
-                            self._push_once(req_id, delta)
-                        except ValueError as exc:
-                            _send_response(conn, b"E",
-                                           str(exc).encode("utf-8"))
-                            continue
+                    if op == b"T":
+                        pending_ctx = _monitor.parse_traceparent(
+                            payload.decode("utf-8", "replace"))
                         _send_response(conn, b"K")
-                    elif op == b"S":
-                        _send_response(conn, b"K", struct.pack(
-                            ">Q", self.server.pushes))
-                    else:
-                        _send_response(conn, b"E",
-                                       f"unknown op {op!r}".encode())
-                        return
+                        continue
+                    if op == b"D":
+                        _send_response(conn, b"K", json.dumps({
+                            "pid": os.getpid(),
+                            "events": _monitor.tracer().events(),
+                        }, default=str).encode("utf-8"))
+                        continue
+                    ctx, pending_ctx = pending_ctx, None
+                    with _monitor.tracer().span(
+                            "param_server/"
+                            + self._OP_NAMES.get(op, "unknown"),
+                            ctx=ctx, nbytes=len(payload)):
+                        if op == b"P":
+                            _send_response(conn, b"K",
+                                           self.server.pull().tobytes())
+                        elif op == b"U":
+                            delta = np.frombuffer(payload, np.float64)
+                            try:
+                                self._push_once(req_id, delta)
+                            except ValueError as exc:
+                                _send_response(conn, b"E",
+                                               str(exc).encode("utf-8"))
+                                continue
+                            _send_response(conn, b"K")
+                        elif op == b"S":
+                            _send_response(conn, b"K", struct.pack(
+                                ">Q", self.server.pushes))
+                        else:
+                            _send_response(conn, b"E",
+                                           f"unknown op {op!r}".encode())
+                            return
         except (ConnectionError, OSError):
             # a worker died mid-message (SIGKILL, network partition):
             # its connection is torn down, the store and every other
@@ -308,15 +335,26 @@ class TcpParameterServerClient:
                 pass
             self._conn = None
 
-    def _request(self, op: bytes, payload: bytes, req_id: int) -> bytes:
+    def _request(self, op: bytes, payload: bytes, req_id: int,
+                 ctx=None) -> bytes:
         """One framed request with bounded retry; caller holds the
         lock.  Transport failures anywhere in the round trip tear the
         socket down and retry the SAME frame (same ``req_id`` — the
-        server dedups pushes whose first attempt landed)."""
+        server dedups pushes whose first attempt landed).  With ``ctx``
+        (a :class:`~..monitor.TraceContext`) a ``T`` frame precedes the
+        request inside each attempt, so the server-side span lands in
+        the caller's trace even across a reconnect."""
         last: Optional[Exception] = None
         for attempt in range(self.max_retries + 1):
             try:
                 conn = self._ensure_conn()
+                if ctx is not None:
+                    _send_frame(conn, b"T", req_id,
+                                ctx.traceparent().encode("utf-8"))
+                    status, _body = _read_response(conn)
+                    if status != b"K":
+                        raise ConnectionError(
+                            f"bad T response status {status!r}")
                 _send_frame(conn, op, req_id, payload)
                 if op == b"U" and _faults.drop_connection():
                     # fault point: the request is on the wire (the
@@ -350,13 +388,26 @@ class TcpParameterServerClient:
 
     def pull(self) -> np.ndarray:
         with self._lock:
-            body = self._request(b"P", b"", next(self._req_ids))
+            with _monitor.span("param_server_client/pull"):
+                body = self._request(b"P", b"", next(self._req_ids),
+                                     ctx=_monitor.current_context())
             return np.frombuffer(body, np.float64).copy()
 
     def push(self, delta: np.ndarray) -> None:
         data = np.asarray(delta, np.float64).tobytes()
         with self._lock:
-            self._request(b"U", data, next(self._req_ids))
+            with _monitor.span("param_server_client/push",
+                               nbytes=len(data)):
+                self._request(b"U", data, next(self._req_ids),
+                              ctx=_monitor.current_context())
+
+    def dump_trace(self) -> Dict:
+        """The server process's span ring: ``{"pid": int, "events":
+        [...]}`` — merge with the local tracer's events to render one
+        cross-process timeline."""
+        with self._lock:
+            body = self._request(b"D", b"", next(self._req_ids))
+        return json.loads(body.decode("utf-8"))
 
     @property
     def pushes(self) -> int:

@@ -105,13 +105,24 @@ class SloShed(ServingError):
 
 
 class _Request:
-    __slots__ = ("arrays", "n_rows", "sig", "t_enqueue", "future")
+    __slots__ = ("arrays", "n_rows", "sig", "t_enqueue", "t_wall",
+                 "t_dequeue", "ctx", "trace_id", "span_id", "future")
 
     def __init__(self, arrays, n_rows, sig):
         self.arrays = arrays
         self.n_rows = n_rows
         self.sig = sig
         self.t_enqueue = time.perf_counter()
+        self.t_wall = time.time()
+        self.t_dequeue = self.t_enqueue
+        # Trace identity is fixed at submit time on the caller's thread:
+        # the request span parents under the caller's ambient context
+        # (e.g. the HTTP server span) and its id is pre-allocated here so
+        # the batch span can link it before the span is recorded.
+        self.ctx = _monitor.current_context()
+        self.trace_id = (self.ctx.trace_id if self.ctx is not None
+                         else _monitor.new_trace_id())
+        self.span_id = _monitor.tracer().next_span_id()
         self.future: Future = Future()
 
 
@@ -238,11 +249,12 @@ class InferenceEngine:
                        "admitted requests waiting to be batched").set(
             self._queue.qsize(), engine=self._name)
 
-    def _observe_latency(self, latency_ms: float) -> None:
+    def _observe_latency(self, latency_ms: float,
+                         trace_hex: Optional[str] = None) -> None:
         _monitor.histogram(
             "serving_request_latency_ms",
             "end-to-end request latency (enqueue -> result), per model"
-        ).observe(latency_ms, model=self._name)
+        ).observe(latency_ms, exemplar=trace_hex, model=self._name)
         if self._admission is not None:
             self._admission.observe(latency_ms)
         self._done_times.append(time.monotonic())
@@ -304,6 +316,11 @@ class InferenceEngine:
                 "serving_shed_total",
                 "requests shed by SLO admission control "
                 "(p99 over target)").inc(engine=self._name)
+            _monitor.record_incident("slo_shed", {
+                "engine": self._name,
+                "observed_p99_ms": float(observed),
+                "slo_p99_ms": float(self._admission.slo_p99_ms),
+            })
             raise SloShed(
                 f"shedding: observed p99 {observed:.1f} ms exceeds the "
                 f"{self._admission.slo_p99_ms:.1f} ms SLO; retry with "
@@ -359,6 +376,10 @@ class InferenceEngine:
             _monitor.counter("serving_rejected_total",
                              "requests rejected at queue capacity").inc(
                 engine=self._name)
+            _monitor.record_incident("queue_full", {
+                "engine": self._name,
+                "queue_capacity": self._queue.maxsize,
+            })
             raise QueueFull(
                 f"serving queue at capacity "
                 f"({self._queue.maxsize}); retry or raise "
@@ -638,6 +659,7 @@ class InferenceEngine:
                     if not self._running:
                         return
                     continue
+                req.t_dequeue = time.perf_counter()
                 self._observe_queue_depth()
             batch, rows = [req], req.n_rows
             deadline = time.perf_counter() + self._max_latency_s
@@ -649,6 +671,7 @@ class InferenceEngine:
                     nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
+                nxt.t_dequeue = time.perf_counter()
                 self._observe_queue_depth()
                 if (nxt.sig != req.sig
                         or rows + nxt.n_rows
@@ -733,6 +756,7 @@ class InferenceEngine:
                       if kind == "seq"]
         seq_i = seq_inputs[0] if len(seq_inputs) == 1 else None
         tb = job.sig[seq_i][2] if seq_i is not None else None
+        self._record_batch_spans(job, t0, now)
         off = 0
         for r in job.requests:
             sl = [o[off:off + r.n_rows] for o in outs]
@@ -743,5 +767,45 @@ class InferenceEngine:
                           if o.ndim >= 3 and o.shape[1] == tb else o
                           for o in sl]
             r.future.set_result(sl[0] if len(sl) == 1 else sl)
-            self._observe_latency((now - r.t_enqueue) * 1000.0)
+            self._observe_latency((now - r.t_enqueue) * 1000.0,
+                                  f"{r.trace_id:032x}")
             off += r.n_rows
+
+    def _record_batch_spans(self, job: _BatchJob, t_exec0: float,
+                            t_done: float) -> None:
+        """Reconstruct the request-level causality as trace spans: one
+        ``serve/request`` span per member (parented under the context
+        captured at submit time), with ``queue_wait`` / ``batch_assembly``
+        / ``dispatch`` child segments, plus one ``serve/batch`` span that
+        *links* every coalesced request span (batch-to-request causality
+        is N:1, not parent/child — the batch belongs to no single
+        request's trace)."""
+        tr = _monitor.tracer()
+        wall_now = time.time()
+
+        def wall(t_perf: float) -> float:
+            return wall_now - (time.perf_counter() - t_perf)
+
+        for r in job.requests:
+            parent = r.ctx.span_id if r.ctx is not None else None
+            tr.record_span(
+                "serve/request", trace_id=r.trace_id, span_id=r.span_id,
+                parent_id=parent, ts=r.t_wall,
+                dur_ms=(t_done - r.t_enqueue) * 1e3,
+                model=self._name, rows=r.n_rows)
+            for seg, seg_t0, seg_t1 in (
+                    ("serve/queue_wait", r.t_enqueue, r.t_dequeue),
+                    ("serve/batch_assembly", r.t_dequeue, t_exec0),
+                    ("serve/dispatch", t_exec0, t_done)):
+                tr.record_span(
+                    seg, trace_id=r.trace_id, parent_id=r.span_id,
+                    ts=wall(seg_t0),
+                    dur_ms=max(0.0, (seg_t1 - seg_t0) * 1e3))
+        lead = job.requests[0]
+        tr.record_span(
+            "serve/batch", trace_id=lead.trace_id,
+            ts=wall(lead.t_dequeue),
+            dur_ms=max(0.0, (t_done - lead.t_dequeue) * 1e3),
+            links=[r.span_id for r in job.requests],
+            model=self._name, rows=job.rows,
+            n_requests=len(job.requests))

@@ -354,6 +354,16 @@ class StreamBroker:
 
     # ---- protocol dispatch ----------------------------------------------
     def _dispatch(self, req: dict) -> dict:
+        # An optional ``_traceparent`` key (W3C header value, injected by
+        # _BrokerConnection.call when the caller runs under a trace)
+        # stitches this broker-side span into the producer/consumer's
+        # distributed trace across the process boundary.
+        ctx = _monitor.parse_traceparent(req.pop("_traceparent", None))
+        with _monitor.tracer().span(
+                "broker/" + str(req.get("op", "unknown")), ctx=ctx):
+            return self._dispatch_op(req)
+
+    def _dispatch_op(self, req: dict) -> dict:
         try:
             op = req["op"]
             if op == "create_topic":
@@ -414,6 +424,9 @@ class _BrokerConnection:
         self._lock = threading.Lock()
 
     def call(self, req: dict) -> dict:
+        ctx = _monitor.current_context()
+        if ctx is not None:
+            req = dict(req, _traceparent=ctx.traceparent())
         with self._lock:
             _send_msg(self._sock, req)
             resp = _recv_msg(self._sock)

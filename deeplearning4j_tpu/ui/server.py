@@ -298,6 +298,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        trace_header = getattr(self, "_trace_header", None)
+        if trace_header:
+            self.send_header("traceparent", trace_header)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -338,8 +341,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _monitor.prometheus_text().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/trace":
-            self._send(200, _monitor.trace_jsonl().encode(),
-                       "application/x-ndjson")
+            trace_id = q.get("trace_id", [None])[0]
+            name = q.get("name", [None])[0]
+            try:
+                limit = (int(q["limit"][0]) if "limit" in q else None)
+            except ValueError:
+                self._send(400, json.dumps(
+                    {"error": "limit must be an integer"}).encode())
+                return
+            if q.get("format", [None])[0] == "chrome":
+                self._send(200, _monitor.trace_chrome_json(
+                    trace_id=trace_id, name=name, limit=limit).encode(),
+                    "application/json")
+            else:
+                self._send(200, _monitor.trace_jsonl(
+                    trace_id=trace_id, name=name, limit=limit).encode(),
+                    "application/x-ndjson")
         elif path == "/healthz":
             self._json(ui.healthz_data())
         elif path == "/health":
@@ -352,6 +369,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- POST /predict (multi-tenant dynamic-batching inference) ---------
     def _predict(self, ui: "UIServer") -> None:
+        """Trace-context shell around the predict route: adopt the
+        client's W3C ``traceparent`` (or mint a fresh trace), wrap the
+        handling in an ``http/predict`` server span so the engine's
+        request span parents under it, and echo the server context back
+        as a ``traceparent`` response header on every outcome."""
+        ctx = _monitor.parse_traceparent(self.headers.get("traceparent"))
+        with _monitor.tracer().span("http/predict", ctx=ctx,
+                                    path="/predict"):
+            current = _monitor.current_context()
+            self._trace_header = (current.traceparent()
+                                  if current is not None else None)
+            try:
+                self._predict_inner(ui)
+            finally:
+                self._trace_header = None
+
+    def _predict_inner(self, ui: "UIServer") -> None:
         import numpy as _np
         from ..serving.engine import QueueFull, ServingError, SloShed
         from ..serving.registry import UnknownModel

@@ -1,0 +1,164 @@
+"""Render a trace dump or flight-recorder bundle as a loadable Perfetto
+file.
+
+Inputs (auto-detected):
+
+- a **flight-recorder bundle directory** (contains ``spans.json`` with
+  ``{"complete": [...], "active": [...]}`` internal span events),
+- a **spans.json** file from such a bundle,
+- a ``/trace`` **JSONL dump** (one Chrome event per line) or a
+  ``/trace?format=chrome`` **JSON array** (already Chrome events),
+- a ``http://host:port/trace`` **URL** (fetched with stdlib urllib).
+
+Output: a single JSON array of Chrome trace events — the format both
+Perfetto (ui.perfetto.dev) and chrome://tracing load directly.  Spans
+from different processes keep their recording ``pid`` so a merged
+multi-process dump (e.g. serving front end + param-server worker)
+separates into per-process tracks; still-open spans from a bundle are
+rendered with an ``unfinished: true`` arg and the duration observed at
+dump time.
+
+Usage::
+
+    python tools/trace_view.py <bundle-dir|spans.json|trace.jsonl|URL>
+        [-o out.trace.json]
+
+Prints a one-line summary (events, traces, pids) on success and exits
+non-zero on anything unparseable — CI uses that as the "bundle is
+renderable" gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+_CHROME_KEYS = {"name", "ph", "ts", "pid", "tid"}
+
+
+def _internal_to_chrome(ev: Dict, unfinished: bool = False,
+                        dump_ts: float = 0.0) -> Dict:
+    """One monitor-internal span event -> one Chrome complete event."""
+    if unfinished:
+        dur_ms = max(0.0, (dump_ts - float(ev["ts"])) * 1e3) \
+            if dump_ts else 0.0
+    else:
+        dur_ms = float(ev.get("dur_ms", 0.0))
+    args = dict(ev.get("attrs") or {}, span_id=ev.get("id"),
+                parent=ev.get("parent"), trace_id=ev.get("trace"))
+    if ev.get("links"):
+        args["links"] = ev["links"]
+    if unfinished:
+        args["unfinished"] = True
+    return {
+        "name": ev.get("name", "?"),
+        "ph": "X",
+        "ts": round(float(ev["ts"]) * 1e6, 1),
+        "dur": round(dur_ms * 1e3, 1),
+        "pid": ev.get("pid", 0),
+        "tid": ev.get("thread", 0),
+        "args": args,
+    }
+
+
+def _looks_chrome(ev: Dict) -> bool:
+    return _CHROME_KEYS <= set(ev)
+
+
+def _from_events(events: List[Dict], active: List[Dict],
+                 dump_ts: float = 0.0) -> List[Dict]:
+    out = []
+    for ev in events:
+        out.append(ev if _looks_chrome(ev) else _internal_to_chrome(ev))
+    for ev in active:
+        out.append(_internal_to_chrome(ev, unfinished=True,
+                                       dump_ts=dump_ts))
+    return out
+
+
+def _load_text(text: str) -> List[Dict]:
+    """Parse a /trace body: JSON array, JSONL, or a spans.json object."""
+    text = text.strip()
+    if not text:
+        return []
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        # JSONL: one event per line
+        events = [json.loads(line) for line in text.splitlines() if line]
+        return _from_events(events, [])
+    if isinstance(obj, list):
+        return _from_events(obj, [])
+    if isinstance(obj, dict) and "complete" in obj:
+        dump_ts = max((float(e.get("ts", 0.0)) +
+                       float(e.get("dur_ms", 0.0)) / 1e3
+                       for e in obj.get("complete", [])), default=0.0)
+        return _from_events(obj.get("complete", []),
+                            obj.get("active", []), dump_ts)
+    if isinstance(obj, dict) and "events" in obj:
+        # a TcpParameterServerClient.dump_trace() payload
+        return _from_events(obj["events"], [])
+    raise ValueError("unrecognized trace JSON shape "
+                     f"(top-level {type(obj).__name__})")
+
+
+def load(source: str) -> List[Dict]:
+    """Chrome events from any supported source (see module docstring)."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return _load_text(resp.read().decode("utf-8", "replace"))
+    if os.path.isdir(source):
+        spans = os.path.join(source, "spans.json")
+        if not os.path.exists(spans):
+            raise FileNotFoundError(
+                f"{source} is a directory but has no spans.json — "
+                "not a flight-recorder bundle")
+        with open(spans) as f:
+            return _load_text(f.read())
+    with open(source) as f:
+        return _load_text(f.read())
+
+
+def summarize(events: List[Dict]) -> str:
+    traces = {e.get("args", {}).get("trace_id") for e in events}
+    traces.discard(None)
+    pids = {e.get("pid") for e in events}
+    return (f"{len(events)} events, {len(traces)} traces, "
+            f"{len(pids)} pids")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert a /trace dump or flight-recorder bundle "
+                    "into a Perfetto/Chrome trace file.")
+    ap.add_argument("source",
+                    help="bundle dir, spans.json, /trace dump, or URL")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <source>.trace.json, "
+                    "or stdout with '-')")
+    args = ap.parse_args(argv)
+    try:
+        events = load(args.source)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print("error: no trace events in source", file=sys.stderr)
+        return 1
+    body = json.dumps(events)
+    if args.out == "-":
+        sys.stdout.write(body + "\n")
+    else:
+        out = args.out or (args.source.rstrip("/") + ".trace.json")
+        with open(out, "w") as f:
+            f.write(body)
+        print(f"wrote {out}: {summarize(events)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
