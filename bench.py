@@ -1357,6 +1357,94 @@ def bench_serving_v2(n_in: int = 32, hidden: int = 128, n_out: int = 8,
             "max_batch": max_batch, "max_latency_ms": max_latency_ms}
 
 
+def bench_scaleout(smoke: bool = False) -> dict:
+    """Compressed-wire async Hogwild vs synchronous data-parallel
+    (``scaleout/async_trainer.py``): K=3 OS-process workers against the
+    TCP parameter server.  Records the three scaleout acceptance
+    numbers on one stdout line:
+
+    - ``wire_reduction_x``: total wire bytes of a topk8 run vs an f32
+      run at equal rounds, with both runs' final accuracy inside the
+      sync-DP parity band (int8-quantized top-k pushes + int8 dense
+      pulls vs dense f32 both ways).
+    - ``value`` (the crossover): async samples/sec over sync-DP
+      samples/sec, both time-boxed under the same seeded one-rank
+      straggler (``DL4J_TPU_FAULT_SLOW_WORKER_MS=rank:ms``) — sync
+      pays the straggler every barrier, async only loses the
+      straggler's own contribution.
+    - ``kill_survived``: a topk8 run with one worker SIGKILLed
+      mid-run (PR-6 preemption simulator) still finishes and converges.
+
+    Sub-run records go to stderr; stdout stays one line.
+    """
+    from deeplearning4j_tpu.scaleout import async_trainer as at
+
+    k = 3
+    rounds = 12 if smoke else 40
+    duration = 1.5 if smoke else 4.0
+    straggler = (1, 120.0 if smoke else 250.0)
+    band = 0.08
+
+    def note(tag, rec):
+        slim = {kk: vv for kk, vv in rec.items() if kk != "workers"}
+        print(json.dumps({"metric": f"scaleout_{tag}", **slim}),
+              file=sys.stderr, flush=True)
+        return rec
+
+    sync = note("sync_dp", at.run_sync_dp(k=k, rounds=rounds))
+    topk = note("async_topk8", at.run_async(k=k, codec="topk8",
+                                            rounds=rounds))
+    f32 = note("async_f32", at.run_async(k=k, codec="f32",
+                                         rounds=rounds))
+    kill = note("async_kill", at.run_async(
+        k=k, codec="topk8", rounds=rounds,
+        die_at_round=(k - 1, max(2, rounds // 3))))
+    a_thr = note("async_straggler", at.run_async(
+        k=k, codec="topk8", rounds=rounds, duration=duration,
+        straggler=straggler))
+    s_thr = note("sync_straggler", at.run_sync_dp(
+        k=k, rounds=rounds, duration=duration, straggler=straggler))
+
+    crossover = (a_thr["samples_per_sec"] / s_thr["samples_per_sec"]
+                 if s_thr["samples_per_sec"] else None)
+    wire_reduction = (f32["wire_bytes"] / topk["wire_bytes"]
+                      if topk["wire_bytes"] else None)
+    lock = monitor.histogram(
+        "server_lock_wait_seconds",
+        "seconds waiting to acquire a parameter-server lock shard"
+    ).stats()
+    return {
+        "metric": "scaleout_async_vs_sync_throughput_x",
+        "value": round(crossover, 2) if crossover else None,
+        "unit": "x", "vs_baseline": None,
+        "k": k, "rounds": rounds, "smoke": smoke,
+        "straggler_rank": straggler[0], "straggler_ms": straggler[1],
+        "async_samples_per_sec": a_thr["samples_per_sec"],
+        "sync_samples_per_sec": s_thr["samples_per_sec"],
+        "crossover_ok": bool(crossover and crossover >= 2.0),
+        "wire_bytes_f32": f32["wire_bytes"],
+        "wire_bytes_topk8": topk["wire_bytes"],
+        "wire_reduction_x": (round(wire_reduction, 2)
+                             if wire_reduction else None),
+        "wire_ok": bool(wire_reduction and wire_reduction >= 3.0),
+        "acc_sync": sync["accuracy"], "acc_async_topk8": topk["accuracy"],
+        "acc_async_f32": f32["accuracy"], "parity_band": band,
+        "parity_ok": bool(
+            abs(topk["accuracy"] - sync["accuracy"]) <= band
+            and abs(f32["accuracy"] - sync["accuracy"]) <= band),
+        "kill_survived": bool(-9 in kill["returncodes"]
+                              and kill["survivors"] == k - 1
+                              and abs(kill["accuracy"] - sync["accuracy"])
+                              <= band),
+        "staleness_max": topk["staleness_max"],
+        "staleness_bound": topk["staleness_bound"],
+        "staleness_gauge_on_metrics": (
+            "scaleout_staleness" in monitor.prometheus_text()),
+        "lock_wait": {"count": lock.get("count"),
+                      "p95_s": lock.get("p95")},
+    }
+
+
 def _serving_compile_count() -> float:
     """Total AOT bucket compiles recorded by the monitor registry —
     proves recompiles stay bounded by the warmed bucket count."""
@@ -1483,6 +1571,14 @@ def main() -> None:
         # asserts value == 1.
         from deeplearning4j_tpu.resilience.chaos import run_chaos
         print(json.dumps(run_chaos(smoke="--smoke" in sys.argv)),
+              flush=True)
+        return
+    if "--scaleout" in sys.argv:
+        # Scaleout proof: K=3 subprocess Hogwild workers on the
+        # compressed wire vs synchronous DP, one stdout JSON line.  The
+        # CI scaleout-async job asserts parity_ok, wire_ok (>=3x), and
+        # staleness_gauge_on_metrics.
+        print(json.dumps(bench_scaleout(smoke="--smoke" in sys.argv)),
               flush=True)
         return
     if "--smoke" in sys.argv:

@@ -23,7 +23,12 @@ Fault points:
                       wire but before the ack — the retry/idempotency
                       exerciser.
 ``slow_worker_ms``    sleep this long at each worker loop head — the
-                      straggler simulator.
+                      straggler simulator.  Accepts ``ms`` (every
+                      worker) or ``rank:ms`` (only the worker passing
+                      that rank to :func:`slow_worker` sleeps — how the
+                      scaleout crossover bench slows exactly one of K
+                      processes deterministically while every process
+                      shares the same environment).
 
 Every injection increments ``fault_injections_total{point=...}`` in the
 metrics registry (except ``die_at_step``, whose process is gone before
@@ -57,12 +62,30 @@ def _env_float(name: str) -> Optional[float]:
     return None if raw in (None, "") else float(raw)
 
 
+def _parse_slow_worker(raw) -> "tuple[Optional[int], float]":
+    """``(target_rank, ms)`` from ``ms`` / ``rank:ms`` / ``(rank, ms)``;
+    rank ``None`` means every worker straggles."""
+    if raw in (None, "", 0, 0.0):
+        return None, 0.0
+    if isinstance(raw, tuple):
+        rank, ms = raw
+        return (None if rank is None else int(rank)), float(ms)
+    s = str(raw)
+    if ":" in s:
+        rank_s, ms_s = s.split(":", 1)
+        return int(rank_s), float(ms_s)
+    return None, float(s)
+
+
 def _from_env() -> dict:
+    rank, ms = _parse_slow_worker(
+        os.environ.get(ENV_PREFIX + "SLOW_WORKER_MS"))
     return {
         "die_at_step": _env_int("DIE_AT_STEP"),
         "corrupt_checkpoint": _env_int("CORRUPT_CHECKPOINT") or 0,
         "drop_connection": _env_int("DROP_CONNECTION") or 0,
-        "slow_worker_ms": _env_float("SLOW_WORKER_MS") or 0.0,
+        "slow_worker_ms": ms,
+        "slow_worker_rank": rank,
     }
 
 
@@ -72,13 +95,17 @@ _spec = _from_env()
 def configure(die_at_step: Optional[int] = None,
               corrupt_checkpoint: int = 0,
               drop_connection: int = 0,
-              slow_worker_ms: float = 0.0) -> None:
-    """Arm fault points programmatically (tests); overrides the env."""
+              slow_worker_ms=0.0) -> None:
+    """Arm fault points programmatically (tests); overrides the env.
+    ``slow_worker_ms`` accepts a float (all workers), ``"rank:ms"``, or
+    a ``(rank, ms)`` tuple (one targeted worker)."""
+    rank, ms = _parse_slow_worker(slow_worker_ms)
     with _lock:
         _spec["die_at_step"] = die_at_step
         _spec["corrupt_checkpoint"] = int(corrupt_checkpoint)
         _spec["drop_connection"] = int(drop_connection)
-        _spec["slow_worker_ms"] = float(slow_worker_ms)
+        _spec["slow_worker_ms"] = ms
+        _spec["slow_worker_rank"] = rank
 
 
 def reset() -> None:
@@ -130,13 +157,20 @@ def drop_connection() -> bool:
     return True
 
 
-def slow_worker() -> None:
-    """Straggler point: sleep ``slow_worker_ms`` if armed."""
+def slow_worker(rank: Optional[int] = None) -> None:
+    """Straggler point: sleep ``slow_worker_ms`` if armed.  A targeted
+    spec (``rank:ms``) only slows the worker whose ``rank`` matches —
+    call sites that know their rank pass it; untargeted specs slow
+    every caller regardless."""
     with _lock:
         ms = _spec.get("slow_worker_ms", 0.0)
-    if ms and ms > 0:
-        _fired("slow_worker_ms")
-        time.sleep(ms / 1000.0)
+        target = _spec.get("slow_worker_rank")
+    if not ms or ms <= 0:
+        return
+    if target is not None and rank != target:
+        return
+    _fired("slow_worker_ms")
+    time.sleep(ms / 1000.0)
 
 
 def corrupt_file(path: str) -> None:

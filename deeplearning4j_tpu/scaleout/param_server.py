@@ -41,6 +41,14 @@ import numpy as np
 from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
 from ..resilience import faults as _faults
+from . import compression as _compression
+
+
+#: default lock-shard / wire-chunk size (elements of the flat vector)
+DEFAULT_CHUNK_SIZE = 65536
+
+_LOCK_WAIT_HELP = ("seconds spent waiting on a parameter-chunk lock "
+                   "(per-chunk shard contention)")
 
 
 class ParameterServer:
@@ -50,29 +58,110 @@ class ParameterServer:
     ``pull()`` returns a snapshot of the current flat parameters;
     ``push(delta)`` applies a worker's parameter delta scaled by
     ``update_scale`` (1/num_workers by default — concurrent full deltas
-    would otherwise apply the same learning signal num_workers times)."""
+    would otherwise apply the same learning signal num_workers times).
+
+    Locking is **sharded per chunk** of ``chunk_size`` elements: pushes
+    touching disjoint chunks apply concurrently instead of serializing
+    on one global lock, and every acquire records its wait on the
+    ``server_lock_wait_seconds`` histogram so the contention win is
+    measurable.  Consequently a ``pull()`` racing a ``push()`` may
+    observe some chunks pre- and some post-update — exactly the
+    staleness Hogwild training tolerates by design (each chunk is
+    individually consistent; a quiescent server always reads clean).
+    ``push_chunk``/``commit_push`` expose the chunk granularity to the
+    streaming TCP front-end, which applies chunk records as they arrive
+    off the socket instead of buffering whole messages.
+    """
 
     def __init__(self, initial_params: np.ndarray,
-                 update_scale: float = 1.0):
+                 update_scale: float = 1.0,
+                 chunk_size: Optional[int] = None):
         self._params = np.array(initial_params, np.float64)
+        self._flat = self._params.reshape(-1)
         self.update_scale = float(update_scale)
-        self._lock = threading.Lock()
+        self.chunk_size = int(chunk_size or DEFAULT_CHUNK_SIZE)
+        self.bounds = _compression.chunk_bounds(self._flat.size,
+                                                self.chunk_size)
+        self._locks = [threading.Lock() for _ in self.bounds]
+        self._meta = threading.Lock()
         self.pushes = 0
+        self.version = 0
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.bounds)
+
+    @property
+    def dim(self) -> int:
+        return self._flat.size
+
+    def _acquire(self, i: int) -> None:
+        lock = self._locks[i]
+        t0 = time.perf_counter()
+        lock.acquire()
+        _monitor.histogram("server_lock_wait_seconds",
+                           _LOCK_WAIT_HELP).observe(
+            time.perf_counter() - t0)
 
     def pull(self) -> np.ndarray:
-        with self._lock:
-            return self._params.copy()
+        out = np.empty_like(self._flat)
+        for i, (s, e) in enumerate(self.bounds):
+            self._acquire(i)
+            try:
+                out[s:e] = self._flat[s:e]
+            finally:
+                self._locks[i].release()
+        return out.reshape(self._params.shape)
 
-    def push(self, delta: np.ndarray) -> None:
+    def pull_chunk(self, i: int) -> np.ndarray:
+        s, e = self.bounds[i]
+        self._acquire(i)
+        try:
+            return self._flat[s:e].copy()
+        finally:
+            self._locks[i].release()
+
+    def push(self, delta: np.ndarray) -> int:
         d = np.asarray(delta, np.float64)
         if d.shape != self._params.shape:
             raise ValueError(
                 f"delta shape {d.shape} != param shape "
                 f"{self._params.shape} (a size-1 delta would silently "
                 "broadcast-corrupt every parameter)")
-        with self._lock:
-            self._params += self.update_scale * d
+        flat = d.reshape(-1)
+        for i, (s, e) in enumerate(self.bounds):
+            self._acquire(i)
+            try:
+                self._flat[s:e] += self.update_scale * flat[s:e]
+            finally:
+                self._locks[i].release()
+        return self.commit_push()
+
+    def push_chunk(self, i: int, values: np.ndarray) -> None:
+        """Apply one chunk of a delta under that chunk's lock only (the
+        streaming front-end's unit of application; call
+        :meth:`commit_push` once per logical push after its last
+        chunk)."""
+        s, e = self.bounds[i]
+        v = np.asarray(values, np.float64)
+        if v.shape != (e - s,):
+            raise ValueError(
+                f"chunk {i} carries {v.shape} values, shard holds "
+                f"{(e - s,)}")
+        self._acquire(i)
+        try:
+            self._flat[s:e] += self.update_scale * v
+        finally:
+            self._locks[i].release()
+
+    def commit_push(self) -> int:
+        """Count one completed logical push; bumps the server version
+        workers use for staleness-bounded pulls.  Returns the new
+        version."""
+        with self._meta:
             self.pushes += 1
+            self.version += 1
+            return self.version
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -98,14 +187,23 @@ _HEADER = struct.Struct(">cQQ")
 _RESP_HEADER = struct.Struct(">cQ")
 
 
-def _read_frame(conn: socket.socket):
-    """One request frame, or ``None`` on clean EOF at a frame boundary
-    (mid-frame EOF raises ConnectionError — the caller counts it)."""
+def _read_req_header(conn: socket.socket):
+    """One request header ``(op, req_id, payload_len)``, or ``None`` on
+    clean EOF at a frame boundary (mid-frame EOF raises ConnectionError
+    — the caller counts it).  The payload is left on the socket so
+    chunked ops can apply it as it streams in."""
     first = conn.recv(1)
     if not first:
         return None
-    op, req_id, n = _HEADER.unpack(first + _recv_exact(
-        conn, _HEADER.size - 1))
+    return _HEADER.unpack(first + _recv_exact(conn, _HEADER.size - 1))
+
+
+def _read_frame(conn: socket.socket):
+    """One fully-buffered request frame (non-streaming ops)."""
+    head = _read_req_header(conn)
+    if head is None:
+        return None
+    op, req_id, n = head
     payload = _recv_exact(conn, n) if n else b""
     return op, req_id, payload
 
@@ -142,10 +240,31 @@ class TcpParameterServer:
     ``Q`` (close).  A client dying mid-frame costs its own connection
     only (counted in ``param_server_client_disconnects_total``); the
     server and every other connection keep serving.
+
+    Compressed wire (this PR, ``compression.py``) — negotiated per
+    connection; clients that skip it keep the raw ops above, so old and
+    new clients interoperate:
+
+    - ``C`` capability byte -> reply ``codec_id(1) ‖ u32 chunk_size``
+      (most-compressed common codec; chunk geometry MUST match the
+      store's lock shards).
+    - ``Z`` compressed push: payload = chunk records ``u32 idx ‖ u32
+      len ‖ enc``, **applied as they stream off the socket** (per-chunk
+      lock, per-``(req_id, chunk)`` dedup — a retry after a mid-stream
+      death re-sends every record and only the missing chunks apply).
+      Reply = ``u64 version`` so the worker tracks staleness for free.
+    - ``G`` coded pull: reply ``u64 version ‖ chunk records`` encoded
+      with the dense variant of the negotiated codec.
+    - ``V`` version probe: reply ``u64 version``.
     """
 
-    #: remembered push req_ids for idempotent retries (per server, FIFO)
-    DEDUP_WINDOW = 4096
+    #: remembered (req_id, chunk) keys for idempotent retries (FIFO).
+    #: Chunked pushes consume one entry per chunk, so the window is
+    #: sized well above DEDUP_PUSHES x typical chunk counts.
+    DEDUP_WINDOW = 65536
+
+    #: codecs this server accepts (capability mask for ``C``)
+    CAPABILITIES = _compression.CAP_ALL
 
     def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
                  port: int = 0):
@@ -157,8 +276,11 @@ class TcpParameterServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._seen: "collections.OrderedDict[int, None]" = \
+        # keys: (req_id, -1) for whole raw pushes, (req_id, chunk_idx)
+        # for streamed chunk records
+        self._seen: "collections.OrderedDict[Tuple[int, int], None]" = \
             collections.OrderedDict()
+        self._first_push_ts: Optional[float] = None
         self._conns: List[socket.socket] = []
         self._threads: List[threading.Thread] = []
         self._accept = threading.Thread(target=self._accept_loop,
@@ -189,7 +311,7 @@ class TcpParameterServer:
         crash between apply and ack is covered by the retry's dedup
         lookup, never by double-application)."""
         with self._lock:
-            if req_id in self._seen:
+            if (req_id, -1) in self._seen:
                 _monitor.counter(
                     "param_server_duplicate_pushes_total",
                     "retried pushes deduplicated by request id").inc()
@@ -198,22 +320,152 @@ class TcpParameterServer:
             # first attempt on another handler thread must not
             # double-apply
             self.server.push(delta)
-            self._seen[req_id] = None
-            while len(self._seen) > self.DEDUP_WINDOW:
-                self._seen.popitem(last=False)
+            self._seen[(req_id, -1)] = None
+            self._trim_seen()
+        self._note_push()
 
-    _OP_NAMES = {b"P": "pull", b"U": "push", b"S": "stats"}
+    def _trim_seen(self) -> None:
+        while len(self._seen) > self.DEDUP_WINDOW:
+            self._seen.popitem(last=False)
+
+    def _apply_chunk_once(self, req_id: int, chunk_idx: int,
+                          values: np.ndarray) -> bool:
+        """Apply one streamed chunk record exactly once per
+        ``(req_id, chunk)``; returns whether it applied (False = a
+        retry's duplicate).  The chunk lock itself lives in the store —
+        this dedup lock is held only for the membership check, so
+        records for disjoint chunks apply concurrently."""
+        with self._lock:
+            if (req_id, chunk_idx) in self._seen:
+                _monitor.counter(
+                    "param_server_duplicate_pushes_total",
+                    "retried pushes deduplicated by request id").inc()
+                return False
+            self._seen[(req_id, chunk_idx)] = None
+            self._trim_seen()
+        self.server.push_chunk(chunk_idx, values)
+        return True
+
+    def _note_push(self) -> None:
+        """Refresh the push-throughput gauge (pushes/sec since the
+        first push this server saw)."""
+        now = time.perf_counter()
+        if self._first_push_ts is None:
+            self._first_push_ts = now
+        elapsed = now - self._first_push_ts
+        if elapsed > 0:
+            _monitor.gauge(
+                "scaleout_pushes_per_sec",
+                "parameter-server push throughput since first push").set(
+                self.server.pushes / elapsed)
+
+    @staticmethod
+    def _wire(direction: str, codec_id: int, nbytes: int) -> None:
+        _monitor.counter(
+            "scaleout_wire_bytes_total",
+            "parameter-server wire bytes by direction and codec").inc(
+            nbytes, dir=direction,
+            codec=_compression.CODEC_NAMES.get(codec_id, "?"))
+
+    _OP_NAMES = {b"P": "pull", b"U": "push", b"S": "stats",
+                 b"Z": "push", b"G": "pull", b"C": "negotiate",
+                 b"V": "version"}
+
+    def _stream_push(self, conn: socket.socket, req_id: int,
+                     nbytes: int, codec: Optional[int]) -> bytes:
+        """Consume one ``Z`` payload **chunk record by chunk record**,
+        applying each to its lock shard as soon as it is off the socket
+        — no full-message buffering, so a large delta starts landing
+        while its tail is still in flight.  Returns the response payload
+        (``u64 version``); raises ValueError after draining the stream
+        on semantic errors so the connection stays frame-synchronized."""
+        consumed = 0
+        applied = 0
+        error: Optional[str] = None
+        while consumed < nbytes:
+            head = _recv_exact(conn, _compression._RECORD_HEAD.size)
+            idx, enc_len = _compression._RECORD_HEAD.unpack(head)
+            enc = _recv_exact(conn, enc_len) if enc_len else b""
+            consumed += _compression._RECORD_HEAD.size + enc_len
+            if error is not None:
+                continue            # drain the rest, stay synchronized
+            if codec is None:
+                error = "compressed push before codec negotiation"
+                continue
+            try:
+                if idx >= self.server.num_chunks:
+                    raise ValueError(
+                        f"chunk index {idx} out of range "
+                        f"({self.server.num_chunks} chunks)")
+                s, e = self.server.bounds[idx]
+                values = _compression.decode_chunk(codec, enc, e - s)
+                if self._apply_chunk_once(req_id, idx, values):
+                    applied += 1
+            except ValueError as exc:
+                error = str(exc)
+        if error is not None:
+            raise ValueError(error)
+        if applied:
+            version = self.server.commit_push()
+            self._note_push()
+        else:
+            # full-duplicate retry: the logical push already counted
+            version = self.server.version
+        return struct.pack(">Q", version)
+
+    def _coded_pull(self, codec: int) -> bytes:
+        """``u64 version ‖ chunk records`` — each chunk copied under its
+        own shard lock (a concurrent push may land between chunks; that
+        is the Hogwild staleness contract, same as the sharded
+        :meth:`ParameterServer.pull`)."""
+        version = self.server.version
+        dense = _compression.dense_codec(codec)
+        records = [(i, _compression.encode_chunk(
+            dense, self.server.pull_chunk(i)))
+            for i in range(self.server.num_chunks)]
+        return struct.pack(">Q", version) + _compression.pack_records(
+            records)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         pending_ctx = None  # set by a T frame, consumed by the next op
+        codec: Optional[int] = None        # negotiated by C
+        last_pull_version = 0              # staleness accounting
         try:
             with conn:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 while True:
-                    frame = _read_frame(conn)
-                    if frame is None:
+                    head = _read_req_header(conn)
+                    if head is None:
                         return
-                    op, req_id, payload = frame
+                    op, req_id, nbytes = head
+                    if op == b"Z":
+                        # streaming op: payload applied as it arrives
+                        ctx, pending_ctx = pending_ctx, None
+                        self._wire("in", codec
+                                   if codec is not None else -1,
+                                   nbytes + _HEADER.size)
+                        with _monitor.tracer().span(
+                                "param_server/push", ctx=ctx,
+                                nbytes=nbytes,
+                                codec=_compression.CODEC_NAMES.get(
+                                    codec, "?")):
+                            try:
+                                body = self._stream_push(
+                                    conn, req_id, nbytes, codec)
+                            except ValueError as exc:
+                                _send_response(conn, b"E",
+                                               str(exc).encode("utf-8"))
+                                continue
+                            _monitor.gauge(
+                                "scaleout_staleness",
+                                "server versions since this worker's "
+                                "last pull, sampled at each push").set(
+                                self.server.version - last_pull_version)
+                            self._wire("out", codec, len(body)
+                                       + _RESP_HEADER.size)
+                            _send_response(conn, b"K", body)
+                        continue
+                    payload = _recv_exact(conn, nbytes) if nbytes else b""
                     if op == b"Q":
                         return
                     if op == b"T":
@@ -227,15 +479,34 @@ class TcpParameterServer:
                             "events": _monitor.tracer().events(),
                         }, default=str).encode("utf-8"))
                         continue
+                    if op == b"C":
+                        chosen = _compression.negotiate(
+                            self.CAPABILITIES,
+                            payload[0] if payload else 0)
+                        if chosen is None:
+                            _send_response(conn, b"E",
+                                           b"no common codec")
+                            continue
+                        codec = chosen
+                        _send_response(conn, b"K", bytes([chosen])
+                                       + struct.pack(
+                                           ">I", self.server.chunk_size))
+                        continue
                     ctx, pending_ctx = pending_ctx, None
                     with _monitor.tracer().span(
                             "param_server/"
                             + self._OP_NAMES.get(op, "unknown"),
-                            ctx=ctx, nbytes=len(payload)):
+                            ctx=ctx, nbytes=nbytes):
                         if op == b"P":
-                            _send_response(conn, b"K",
-                                           self.server.pull().tobytes())
+                            body = self.server.pull().tobytes()
+                            self._wire("in", 0, _HEADER.size)
+                            self._wire("out", 0,
+                                       len(body) + _RESP_HEADER.size)
+                            last_pull_version = self.server.version
+                            _send_response(conn, b"K", body)
                         elif op == b"U":
+                            self._wire("in", 0,
+                                       nbytes + _HEADER.size)
                             delta = np.frombuffer(payload, np.float64)
                             try:
                                 self._push_once(req_id, delta)
@@ -243,7 +514,30 @@ class TcpParameterServer:
                                 _send_response(conn, b"E",
                                                str(exc).encode("utf-8"))
                                 continue
+                            _monitor.gauge(
+                                "scaleout_staleness",
+                                "server versions since this worker's "
+                                "last pull, sampled at each push").set(
+                                self.server.version - last_pull_version)
                             _send_response(conn, b"K")
+                        elif op == b"G":
+                            if codec is None:
+                                _send_response(
+                                    conn, b"E",
+                                    b"coded pull before codec "
+                                    b"negotiation")
+                                continue
+                            body = self._coded_pull(codec)
+                            self._wire("in", codec, _HEADER.size)
+                            self._wire(
+                                "out", _compression.dense_codec(codec),
+                                len(body) + _RESP_HEADER.size)
+                            last_pull_version, = struct.unpack(
+                                ">Q", body[:8])
+                            _send_response(conn, b"K", body)
+                        elif op == b"V":
+                            _send_response(conn, b"K", struct.pack(
+                                ">Q", self.server.version))
                         elif op == b"S":
                             _send_response(conn, b"K", struct.pack(
                                 ">Q", self.server.pushes))
@@ -294,11 +588,22 @@ class TcpParameterServerClient:
     so a retry after a lost ack is deduplicated server-side instead of
     double-applied.  ``E`` responses (semantic rejection, e.g. a
     dimension mismatch) raise ``ValueError`` immediately — they are
-    deterministic and never retried."""
+    deterministic and never retried.
+
+    Compressed wire: pass ``codec`` (``"f32"``, ``"int8"``, ``"topk8"``
+    or ``"auto"``) to negotiate a delta codec per connection
+    (re-negotiated transparently after a reconnect) and use
+    :meth:`push_delta` / :meth:`pull_coded` instead of the raw
+    :meth:`push` / :meth:`pull`.  Lossy codecs carry an
+    :class:`~.compression.ErrorFeedback` residual on this client; push
+    acks return the server version so :meth:`staleness` is free —
+    workers pull only when it exceeds their bound."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  max_retries: int = 5, backoff_base: float = 0.05,
-                 backoff_max: float = 2.0):
+                 backoff_max: float = 2.0,
+                 codec: Optional[str] = None,
+                 topk_fraction: float = 0.1):
         self._address = (host, port)
         self._timeout = float(timeout)
         self.max_retries = int(max_retries)
@@ -313,6 +618,14 @@ class TcpParameterServerClient:
         # different clients (and client restarts) disjoint in the
         # server's dedup window
         self._req_ids = itertools.count(rng.getrandbits(64))
+        self._cap_mask = _compression.capability_mask(codec)
+        self.topk_fraction = float(topk_fraction)
+        self.codec_id: Optional[int] = None    # set by negotiation
+        self.chunk_size: Optional[int] = None  # server's shard geometry
+        self._conn_negotiated = False          # per-connection state
+        self._ef: Optional[_compression.ErrorFeedback] = None
+        self.server_version = 0   # latest version seen in any ack
+        self.local_version = 0    # version our params correspond to
 
     def _ensure_conn(self) -> socket.socket:
         if self._conn is None:
@@ -334,20 +647,51 @@ class TcpParameterServerClient:
             except OSError:
                 pass
             self._conn = None
+        self._conn_negotiated = False
+
+    def _negotiate_on_conn(self, conn: socket.socket) -> None:
+        """``C`` exchange on the current socket (codec state is
+        per-connection, so a reconnect re-negotiates before the retried
+        frame goes out)."""
+        _send_frame(conn, b"C", 0, bytes([self._cap_mask]))
+        status, body = _read_response(conn)
+        if status != b"K":
+            raise ValueError(body.decode("utf-8", "replace")
+                             or "codec negotiation rejected")
+        chosen = body[0]
+        (chunk_size,) = struct.unpack(">I", body[1:5])
+        if self.codec_id is not None and chosen != self.codec_id:
+            # a server restart with different capabilities mid-run
+            # would silently corrupt the error-feedback residual
+            raise ValueError(
+                f"server renegotiated codec "
+                f"{_compression.CODEC_NAMES.get(chosen)} != established "
+                f"{_compression.CODEC_NAMES.get(self.codec_id)}")
+        self.codec_id = chosen
+        self.chunk_size = chunk_size
+        self._conn_negotiated = True
 
     def _request(self, op: bytes, payload: bytes, req_id: int,
-                 ctx=None) -> bytes:
+                 ctx=None, coded: bool = False) -> bytes:
         """One framed request with bounded retry; caller holds the
         lock.  Transport failures anywhere in the round trip tear the
         socket down and retry the SAME frame (same ``req_id`` — the
         server dedups pushes whose first attempt landed).  With ``ctx``
         (a :class:`~..monitor.TraceContext`) a ``T`` frame precedes the
         request inside each attempt, so the server-side span lands in
-        the caller's trace even across a reconnect."""
+        the caller's trace even across a reconnect.  ``coded`` requests
+        are preceded by a ``C`` negotiation on any not-yet-negotiated
+        connection."""
         last: Optional[Exception] = None
         for attempt in range(self.max_retries + 1):
             try:
                 conn = self._ensure_conn()
+                if coded and not self._conn_negotiated:
+                    if self._cap_mask is None:
+                        raise ValueError(
+                            "this client was built without a codec: "
+                            "pass codec= to use push_delta/pull_coded")
+                    self._negotiate_on_conn(conn)
                 if ctx is not None:
                     _send_frame(conn, b"T", req_id,
                                 ctx.traceparent().encode("utf-8"))
@@ -356,7 +700,7 @@ class TcpParameterServerClient:
                         raise ConnectionError(
                             f"bad T response status {status!r}")
                 _send_frame(conn, op, req_id, payload)
-                if op == b"U" and _faults.drop_connection():
+                if op in (b"U", b"Z") and _faults.drop_connection():
                     # fault point: the request is on the wire (the
                     # server may apply it) but the ack never arrives
                     self._drop_conn()
@@ -401,6 +745,96 @@ class TcpParameterServerClient:
                 self._request(b"U", data, next(self._req_ids),
                               ctx=_monitor.current_context())
 
+    # -- compressed/coded surface ---------------------------------------
+
+    def _ensure_negotiated(self) -> None:
+        """Resolve codec + chunk geometry before building a coded
+        payload (a cheap ``V`` probe triggers the ``C`` preamble)."""
+        if self.codec_id is None or self.chunk_size is None:
+            body = self._request(b"V", b"", next(self._req_ids),
+                                 coded=True)
+            (self.server_version,) = struct.unpack(">Q", body)
+
+    def push_delta(self, delta: np.ndarray) -> int:
+        """Compressed, error-fed push.  Encodes ``delta + residual``
+        under the negotiated codec, streams it as chunk records, and
+        returns the server version from the ack (feeding
+        :meth:`staleness`).  The payload is encoded ONCE per logical
+        push — a transport retry re-sends identical bytes, so the
+        server's per-chunk dedup and this client's residual stay
+        consistent under at-least-once delivery."""
+        flat = np.asarray(delta, np.float64).reshape(-1)
+        with self._lock:
+            self._ensure_negotiated()
+            if self._ef is None or self._ef.residual.size != flat.size:
+                self._ef = _compression.ErrorFeedback(
+                    flat.size, self.codec_id, self.chunk_size,
+                    self.topk_fraction)
+            payload = _compression.pack_records(self._ef.encode(flat))
+            with _monitor.span(
+                    "param_server_client/push",
+                    nbytes=len(payload),
+                    codec=_compression.CODEC_NAMES[self.codec_id]):
+                body = self._request(b"Z", payload,
+                                     next(self._req_ids),
+                                     ctx=_monitor.current_context(),
+                                     coded=True)
+            (self.server_version,) = struct.unpack(">Q", body)
+            self._wire_client("out", self.codec_id, len(payload))
+            return self.server_version
+
+    def pull_coded(self) -> np.ndarray:
+        """Full parameter snapshot under the dense variant of the
+        negotiated codec; synchronizes :meth:`staleness` to zero."""
+        with self._lock:
+            self._ensure_negotiated()
+            with _monitor.span(
+                    "param_server_client/pull",
+                    codec=_compression.CODEC_NAMES[self.codec_id]):
+                body = self._request(b"G", b"", next(self._req_ids),
+                                     ctx=_monitor.current_context(),
+                                     coded=True)
+            (version,) = struct.unpack(">Q", body[:8])
+            dense = _compression.dense_codec(self.codec_id)
+            bounds = None
+            if self.chunk_size:
+                # total dim is whatever the records cover; bounds are
+                # rebuilt once the payload names the last chunk
+                records = _compression.unpack_records(body[8:])
+                dim = 0
+                for idx, enc in records:
+                    if dense == _compression.CODEC_F32:
+                        dim += len(enc) // 4
+                    else:
+                        dim += len(enc) - 8   # int8: 8-byte affine head
+                bounds = _compression.chunk_bounds(dim, self.chunk_size)
+            params = _compression.decode_dense(dense, body[8:], bounds)
+            self.server_version = self.local_version = version
+            self._wire_client("in", dense, len(body))
+            return params
+
+    def staleness(self) -> int:
+        """Server versions elapsed since this client's last coded pull
+        (updated for free by every push ack)."""
+        return self.server_version - self.local_version
+
+    def version(self) -> int:
+        """The server's current version counter (``V`` probe)."""
+        with self._lock:
+            body = self._request(b"V", b"", next(self._req_ids),
+                                 coded=self._cap_mask is not None)
+            (v,) = struct.unpack(">Q", body)
+            self.server_version = v
+            return v
+
+    @staticmethod
+    def _wire_client(direction: str, codec_id: int, nbytes: int) -> None:
+        _monitor.counter(
+            "scaleout_wire_bytes_total",
+            "parameter-server wire bytes by direction and codec").inc(
+            nbytes, dir=direction,
+            codec=_compression.CODEC_NAMES.get(codec_id, "?"))
+
     def dump_trace(self) -> Dict:
         """The server process's span ring: ``{"pid": int, "events":
         [...]}`` — merge with the local tracer's events to render one
@@ -444,18 +878,29 @@ class ParameterServerParallelWrapper:
     def __init__(self, model, num_workers: int = 2,
                  batches_per_push: int = 1,
                  update_scale: Optional[float] = None,
-                 server_address: Optional[tuple] = None):
+                 server_address: Optional[tuple] = None,
+                 codec: Optional[str] = None,
+                 staleness_bound: int = 0):
         """``server_address=(host, port)`` switches workers to the TCP
         transport against an external server process (reference: Aeron
         clients against a remote ParameterServerNode); default is the
         in-process store.  In TCP mode the SERVER owns ``update_scale``
         (``--update-scale`` on its command line) — passing it here would
-        be silently ignored, so it raises instead."""
+        be silently ignored, so it raises instead.  ``codec`` (TCP mode
+        only) switches workers to the compressed wire; with
+        ``staleness_bound > 0`` they keep training on their local
+        replica and re-pull only once the push-ack version says they
+        are more than ``staleness_bound`` versions stale."""
         self.model = model.init() if hasattr(model, "init") else model
         self.num_workers = int(num_workers)
         self.batches_per_push = int(batches_per_push)
         self._address = server_address
+        self.codec = codec
+        self.staleness_bound = int(staleness_bound)
         if server_address is None:
+            if codec is not None:
+                raise ValueError("codec applies to the TCP transport; "
+                                 "the in-process store has no wire")
             scale = (1.0 / self.num_workers if update_scale is None
                      else update_scale)
             self.server = ParameterServer(self.model.get_flat_params(),
@@ -465,7 +910,8 @@ class ParameterServerParallelWrapper:
                 raise ValueError(
                     "update_scale is server-side in TCP mode: launch the "
                     "server with --update-scale instead")
-            self.server = TcpParameterServerClient(*server_address)
+            self.server = TcpParameterServerClient(*server_address,
+                                                   codec=codec)
         self._replicas = [self.model.clone()
                           for _ in range(self.num_workers)]
         self._errors: List[BaseException] = []
@@ -488,25 +934,41 @@ class ParameterServerParallelWrapper:
         shared across threads; the in-process store is)."""
         if self._address is None:
             return self.server
-        return TcpParameterServerClient(*self._address)
+        return TcpParameterServerClient(*self._address, codec=self.codec)
 
-    def _worker(self, replica, batches: List[DataSet]) -> None:
+    def _worker(self, rank: int, replica,
+                batches: List[DataSet]) -> None:
         server = None
+        coded = self._address is not None and self.codec is not None
         try:
             server = self._make_worker_client()
             i = 0
+            local = None    # coded path: staleness-bounded local params
             while i < len(batches):
-                _faults.slow_worker()   # straggler fault point (no-op
-                #                         unless DL4J_TPU_FAULT_SLOW_
-                #                         WORKER_MS is armed)
-                start = server.pull()
+                _faults.slow_worker(rank)   # straggler fault point
+                #                             (no-op unless DL4J_TPU_
+                #                             FAULT_SLOW_WORKER_MS armed;
+                #                             rank:ms targets one worker)
+                if coded:
+                    if local is None or (server.staleness()
+                                         > self.staleness_bound):
+                        local = server.pull_coded()
+                    start = local
+                else:
+                    start = server.pull()
                 replica.set_flat_params(start)
                 for _ in range(self.batches_per_push):
                     if i >= len(batches):
                         break
                     replica._fit_batch(batches[i])
                     i += 1
-                server.push(replica.get_flat_params() - start)
+                delta = replica.get_flat_params() - start
+                if coded:
+                    server.push_delta(delta)
+                    local = start + delta   # keep training locally until
+                    #                         the staleness bound trips
+                else:
+                    server.push(delta)
         except BaseException as e:  # surfaced after join
             self._errors.append(e)
         finally:
@@ -528,8 +990,9 @@ class ParameterServerParallelWrapper:
             for i, b in enumerate(batches):
                 shards[i % self.num_workers].append(b)
             threads = [threading.Thread(target=self._worker,
-                                        args=(r, s), daemon=True)
-                       for r, s in zip(self._replicas, shards) if s]
+                                        args=(rank, r, s), daemon=True)
+                       for rank, (r, s) in enumerate(
+                           zip(self._replicas, shards)) if s]
             for t in threads:
                 t.start()
             for t in threads:
@@ -557,6 +1020,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", type=str, default="127.0.0.1")
     ap.add_argument("--update-scale", type=float, default=1.0)
+    ap.add_argument("--chunk-size", type=int, default=None,
+                    help="lock-shard / wire-chunk size in elements")
     args = ap.parse_args(argv)
 
     if args.init:
@@ -565,7 +1030,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         init = np.zeros(args.dim, np.float64)
     else:
         ap.error("--dim or --init required")
-    store = ParameterServer(init, update_scale=args.update_scale)
+    store = ParameterServer(init, update_scale=args.update_scale,
+                            chunk_size=args.chunk_size)
     srv = TcpParameterServer(store, host=args.host, port=args.port)
     print(json.dumps({"host": srv.host, "port": srv.port}), flush=True)
     try:

@@ -1,0 +1,217 @@
+"""Delta-codec tests (``scaleout/compression.py``): roundtrip error
+bounds per codec, error-feedback accumulation, record framing, and
+capability negotiation — the worker-side half of the compressed wire
+(the server-side half lives in ``test_scaleout_async.py``)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.scaleout import compression as comp
+
+
+# ------------------------------------------------------- negotiation
+
+def test_capability_mask_mapping():
+    assert comp.capability_mask(None) is None
+    assert comp.capability_mask("f64") is None
+    assert comp.capability_mask("raw") is None
+    assert comp.capability_mask("f32") == comp.CAP_F32
+    assert comp.capability_mask("int8") == comp.CAP_INT8
+    assert comp.capability_mask("topk8") == comp.CAP_TOPK8
+    assert comp.capability_mask("auto") == comp.CAP_ALL
+    with pytest.raises(ValueError, match="unknown codec"):
+        comp.capability_mask("zstd")
+
+
+def test_negotiate_prefers_most_compressed():
+    assert comp.negotiate(comp.CAP_ALL, comp.CAP_ALL) == comp.CODEC_TOPK8
+    assert comp.negotiate(comp.CAP_ALL,
+                          comp.CAP_F32 | comp.CAP_INT8) == comp.CODEC_INT8
+    assert comp.negotiate(comp.CAP_F32, comp.CAP_ALL) == comp.CODEC_F32
+    assert comp.negotiate(comp.CAP_F32, comp.CAP_INT8) is None
+    assert comp.negotiate(0, comp.CAP_ALL) is None
+
+
+def test_dense_codec_maps_topk_to_int8():
+    assert comp.dense_codec(comp.CODEC_TOPK8) == comp.CODEC_INT8
+    assert comp.dense_codec(comp.CODEC_INT8) == comp.CODEC_INT8
+    assert comp.dense_codec(comp.CODEC_F32) == comp.CODEC_F32
+
+
+def test_chunk_bounds_cover_and_validate():
+    assert comp.chunk_bounds(10, 4) == [(0, 4), (4, 8), (8, 10)]
+    assert comp.chunk_bounds(4, 4) == [(0, 4)]
+    assert comp.chunk_bounds(3, 64) == [(0, 3)]
+    with pytest.raises(ValueError, match="positive"):
+        comp.chunk_bounds(10, 0)
+
+
+# ------------------------------------------------- roundtrip bounds
+
+def test_f32_roundtrip_near_exact():
+    rng = np.random.RandomState(0)
+    x = rng.randn(257)
+    enc = comp.encode_chunk(comp.CODEC_F32, x)
+    assert len(enc) == 4 * x.size
+    dec = comp.decode_chunk(comp.CODEC_F32, enc, x.size)
+    np.testing.assert_allclose(dec, x, rtol=1e-6)
+
+
+def test_int8_roundtrip_error_bound():
+    """Affine uint8 worst-case rounding error is half a quantization
+    step: (hi - lo) / 510 (plus f32 decode rounding)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(300) * 5.0
+    enc = comp.encode_chunk(comp.CODEC_INT8, x)
+    assert len(enc) == 8 + x.size
+    dec = comp.decode_chunk(comp.CODEC_INT8, enc, x.size)
+    bound = (x.max() - x.min()) / 510.0 * 1.01
+    assert np.abs(dec - x).max() <= bound
+
+
+def test_int8_constant_chunk_exact():
+    x = np.full(16, 3.25)
+    dec = comp.decode_chunk(comp.CODEC_INT8,
+                            comp.encode_chunk(comp.CODEC_INT8, x), 16)
+    np.testing.assert_allclose(dec, x, rtol=1e-7)
+
+
+def test_int8_rejects_non_finite():
+    with pytest.raises(ValueError, match="non-finite"):
+        comp.encode_chunk(comp.CODEC_INT8, np.array([1.0, np.nan]))
+
+
+def test_topk8_keeps_largest_magnitudes():
+    x = np.zeros(100)
+    x[7], x[42], x[91] = 10.0, -8.0, 5.0
+    x += np.linspace(0.001, 0.01, 100)       # small background noise
+    enc = comp.encode_chunk(comp.CODEC_TOPK8, x, topk_fraction=0.03)
+    dec = comp.decode_chunk(comp.CODEC_TOPK8, enc, 100)
+    kept = np.nonzero(dec)[0]
+    assert set(kept) == {7, 42, 91}
+    rng_bound = (dec[kept].max() - dec[kept].min()) / 510.0 * 1.01
+    assert np.abs(dec[kept] - x[kept]).max() <= max(rng_bound, 1e-6)
+
+
+def test_topk8_wire_size_is_fractional():
+    x = np.random.RandomState(2).randn(1000)
+    enc_topk = comp.encode_chunk(comp.CODEC_TOPK8, x, topk_fraction=0.1)
+    enc_f32 = comp.encode_chunk(comp.CODEC_F32, x)
+    # 100 kept values at 5 bytes each + 12-byte head vs 4000 bytes dense
+    assert len(enc_topk) < len(enc_f32) / 3
+
+
+def test_unknown_codec_rejected():
+    with pytest.raises(ValueError, match="unknown codec id"):
+        comp.encode_chunk(99, np.ones(4))
+    with pytest.raises(ValueError, match="unknown codec id"):
+        comp.decode_chunk(99, b"\x00" * 16, 4)
+
+
+def test_decode_validates_length_and_indices():
+    with pytest.raises(ValueError, match="carries"):
+        comp.decode_chunk(comp.CODEC_F32,
+                          comp.encode_chunk(comp.CODEC_F32, np.ones(4)), 5)
+    with pytest.raises(ValueError, match="carries"):
+        comp.decode_chunk(comp.CODEC_INT8,
+                          comp.encode_chunk(comp.CODEC_INT8, np.ones(4)), 3)
+    enc = comp.encode_chunk(comp.CODEC_TOPK8, np.arange(8.0))
+    with pytest.raises(ValueError, match="out of range"):
+        comp.decode_chunk(comp.CODEC_TOPK8, enc, 4)
+
+
+# ------------------------------------------------------ record framing
+
+def test_pack_unpack_records_roundtrip():
+    recs = [(0, b"abc"), (3, b""), (7, b"\x00" * 9)]
+    assert comp.unpack_records(comp.pack_records(recs)) == recs
+
+
+def test_unpack_records_truncated_raises():
+    buf = comp.pack_records([(0, b"abcdef")])
+    with pytest.raises(ValueError, match="truncated"):
+        comp.unpack_records(buf[:-2])
+
+
+def test_decode_dense_roundtrip_and_ordering():
+    rng = np.random.RandomState(3)
+    x = rng.randn(130)
+    bounds = comp.chunk_bounds(130, 64)
+    recs = [(i, comp.encode_chunk(comp.CODEC_INT8, x[s:e]))
+            for i, (s, e) in enumerate(bounds)]
+    out = comp.decode_dense(comp.CODEC_INT8, comp.pack_records(recs),
+                            bounds)
+    assert np.abs(out - x).max() <= (x.max() - x.min()) / 510.0 * 1.01
+    with pytest.raises(ValueError, match="out of order"):
+        comp.decode_dense(comp.CODEC_INT8,
+                          comp.pack_records(recs[::-1]), bounds)
+
+
+# ------------------------------------------------------ error feedback
+
+def _apply(chunks, codec, bounds, dim):
+    out = np.zeros(dim)
+    for i, enc in chunks:
+        s, e = bounds[i]
+        out[s:e] = comp.decode_chunk(codec, enc, e - s)
+    return out
+
+
+@pytest.mark.parametrize("codec", [comp.CODEC_INT8, comp.CODEC_TOPK8])
+def test_error_feedback_sum_tracks_raw_deltas(codec):
+    """The running sum of decoded pushes must equal the running sum of
+    raw deltas to within the current residual — the 1-bit-SGD invariant
+    that makes lossy pushes converge."""
+    rng = np.random.RandomState(4)
+    dim, chunk = 130, 64
+    ef = comp.ErrorFeedback(dim, codec, chunk, topk_fraction=0.1)
+    raw_sum = np.zeros(dim)
+    dec_sum = np.zeros(dim)
+    for _ in range(25):
+        delta = rng.randn(dim) * 0.1
+        raw_sum += delta
+        dec_sum += _apply(ef.encode(delta), codec, ef.bounds, dim)
+    np.testing.assert_allclose(dec_sum + ef.residual, raw_sum,
+                               atol=1e-12)
+
+
+def test_error_feedback_beats_feedbackless_topk():
+    """Accumulating top-k pushes WITHOUT feedback permanently drops the
+    small coordinates; with feedback they drain through the residual."""
+    rng = np.random.RandomState(5)
+    dim, chunk, n = 128, 64, 40
+    deltas = [rng.randn(dim) * 0.1 for _ in range(n)]
+    raw_sum = np.sum(deltas, axis=0)
+
+    ef = comp.ErrorFeedback(dim, comp.CODEC_TOPK8, chunk)
+    with_fb = np.zeros(dim)
+    for d in deltas:
+        with_fb += _apply(ef.encode(d), comp.CODEC_TOPK8, ef.bounds, dim)
+
+    bounds = comp.chunk_bounds(dim, chunk)
+    without = np.zeros(dim)
+    for d in deltas:
+        for i, (s, e) in enumerate(bounds):
+            enc = comp.encode_chunk(comp.CODEC_TOPK8, d[s:e])
+            without[s:e] += comp.decode_chunk(comp.CODEC_TOPK8, enc,
+                                              e - s)
+    err_fb = np.linalg.norm(with_fb - raw_sum)
+    err_no = np.linalg.norm(without - raw_sum)
+    assert err_fb < err_no / 3
+
+
+def test_error_feedback_dim_mismatch_raises():
+    ef = comp.ErrorFeedback(8, comp.CODEC_INT8, 4)
+    with pytest.raises(ValueError, match="dim"):
+        ef.encode(np.ones(9))
+
+
+def test_error_feedback_encode_is_deterministic():
+    """A retried push must re-send byte-identical records (the server
+    dedups per (req_id, chunk); a different encoding of the same logical
+    push would corrupt the residual under at-least-once delivery)."""
+    rng = np.random.RandomState(6)
+    delta = rng.randn(100)
+    a = comp.ErrorFeedback(100, comp.CODEC_TOPK8, 64)
+    b = comp.ErrorFeedback(100, comp.CODEC_TOPK8, 64)
+    assert a.encode(delta) == b.encode(delta)

@@ -428,13 +428,38 @@ def test_faults_env_parsing(monkeypatch):
     monkeypatch.setenv("DL4J_TPU_FAULT_SLOW_WORKER_MS", "1.5")
     faults.reset()
     assert faults.spec() == {"die_at_step": 17, "corrupt_checkpoint": 2,
-                             "drop_connection": 1, "slow_worker_ms": 1.5}
+                             "drop_connection": 1, "slow_worker_ms": 1.5,
+                             "slow_worker_rank": None}
     assert faults.corrupt_checkpoint() is True
     assert faults.corrupt_checkpoint() is True
     assert faults.corrupt_checkpoint() is False      # tokens consumed
     t0 = time.perf_counter()
     faults.slow_worker()
     assert time.perf_counter() - t0 >= 0.001
+
+
+def test_faults_slow_worker_rank_targeting(monkeypatch):
+    """``rank:ms`` slows exactly one worker: every process can share the
+    same environment and still produce a single deterministic
+    straggler (the scaleout crossover bench's contract)."""
+    monkeypatch.setenv("DL4J_TPU_FAULT_SLOW_WORKER_MS", "2:40")
+    faults.reset()
+    spec = faults.spec()
+    assert spec["slow_worker_ms"] == 40.0
+    assert spec["slow_worker_rank"] == 2
+    t0 = time.perf_counter()
+    faults.slow_worker(rank=0)      # not the target: no sleep
+    faults.slow_worker()            # rankless caller: not the target
+    assert time.perf_counter() - t0 < 0.030
+    t0 = time.perf_counter()
+    faults.slow_worker(rank=2)      # the target straggles
+    assert time.perf_counter() - t0 >= 0.035
+    monkeypatch.delenv("DL4J_TPU_FAULT_SLOW_WORKER_MS")
+    faults.reset()
+    # programmatic tuple form mirrors the env form
+    faults.configure(slow_worker_ms=(1, 5.0))
+    assert faults.spec()["slow_worker_rank"] == 1
+    faults.reset()
 
 
 # ------------------------------------- mixed-precision checkpointing

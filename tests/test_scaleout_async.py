@@ -1,0 +1,273 @@
+"""Compressed-wire + K-process async runtime tests: codec negotiation
+and old/new client interop on one server, streamed chunk idempotency
+under the drop-connection fault, staleness-bounded pulls, traceparent
+stitching for retried compressed pushes, and the subprocess Hogwild
+scenario (``scaleout/async_trainer.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.scaleout import compression as comp
+from deeplearning4j_tpu.scaleout.param_server import (
+    ParameterServer, ParameterServerParallelWrapper, TcpParameterServer,
+    TcpParameterServerClient)
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    monitor.reset()
+    faults.reset()
+    yield
+    monitor.reset()
+    faults.reset()
+
+
+def _server(dim=8, chunk=4, scale=1.0):
+    store = ParameterServer(np.zeros(dim), update_scale=scale,
+                            chunk_size=chunk)
+    return store, TcpParameterServer(store)
+
+
+# ------------------------------------------------ negotiation / interop
+
+def test_negotiation_auto_prefers_topk8_and_reports_geometry():
+    store, srv = _server(dim=10, chunk=4)
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="auto") as c:
+            c.version()     # triggers the C preamble
+            assert c.codec_id == comp.CODEC_TOPK8
+            assert c.chunk_size == 4
+    finally:
+        srv.close()
+
+
+def test_negotiation_respects_server_capabilities():
+    store, srv = _server()
+    srv.CAPABILITIES = comp.CAP_F32      # a down-level server
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="auto") as c:
+            c.version()
+            assert c.codec_id == comp.CODEC_F32
+    finally:
+        srv.close()
+
+
+def test_negotiation_no_common_codec_rejected():
+    store, srv = _server()
+    srv.CAPABILITIES = comp.CAP_F32
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="topk8") as c:
+            with pytest.raises(ValueError, match="no common codec"):
+                c.push_delta(np.ones(8))
+    finally:
+        srv.close()
+
+
+def test_mixed_old_and_new_clients_one_server():
+    """A legacy raw-f64 client and a compressed client interoperate
+    against the same server: both pushes land, both pulls see the
+    consolidated state."""
+    store, srv = _server(dim=8, chunk=4)
+    try:
+        # one dominant element per chunk -> top-k keeps exactly those,
+        # and a single kept value quantizes exactly (constant chunk)
+        sparse = np.zeros(8)
+        sparse[1], sparse[6] = 5.0, -7.0
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="topk8") as new, \
+                TcpParameterServerClient(srv.host, srv.port) as old:
+            new.push_delta(sparse)
+            old.push(np.ones(8))
+            expect = sparse + np.ones(8)
+            np.testing.assert_allclose(old.pull(), expect, atol=1e-9)
+            np.testing.assert_allclose(new.pull_coded(), expect,
+                                       atol=0.05)
+            assert old.pushes == 2
+        assert store.version == 2
+    finally:
+        srv.close()
+
+
+def test_coded_pull_before_negotiation_is_rejected_for_legacy_client():
+    store, srv = _server()
+    try:
+        with TcpParameterServerClient(srv.host, srv.port) as old:
+            with pytest.raises(ValueError, match="without a codec"):
+                old.push_delta(np.ones(8))
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------- idempotency under faults
+
+def test_compressed_push_idempotent_under_drop_fault():
+    """The drop fault severs the connection after the Z frame is on the
+    wire (server applies it) but before the ack; the retry re-sends
+    identical records and every chunk dedups — one logical push, one
+    version bump, residual consistent."""
+    store, srv = _server(dim=8, chunk=4)
+    dup = monitor.counter("param_server_duplicate_pushes_total", "")
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="int8") as c:
+            c.version()                     # negotiate before arming
+            faults.configure(drop_connection=1)
+            delta = np.linspace(-1.0, 1.0, 8)
+            version = c.push_delta(delta)
+            assert version == 1
+        assert store.pushes == 1 and store.version == 1
+        assert dup.value() == 2             # both chunks deduped on retry
+        bound = 2.0 / 510.0 * 1.01          # per-chunk affine error
+        assert np.abs(store.pull() - delta).max() <= bound
+        assert monitor.counter("param_server_retries_total",
+                               "").value() >= 1
+    finally:
+        srv.close()
+
+
+def test_raw_push_still_idempotent_under_drop_fault():
+    store, srv = _server(dim=8, chunk=4)
+    try:
+        with TcpParameterServerClient(srv.host, srv.port) as c:
+            faults.configure(drop_connection=1)
+            c.push(np.ones(8))
+        assert store.pushes == 1
+        np.testing.assert_allclose(store.pull(), np.ones(8))
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------- staleness tracking
+
+def test_staleness_tracks_versions_and_resets_on_pull():
+    store, srv = _server(dim=8, chunk=4)
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="int8") as a, \
+                TcpParameterServerClient(srv.host, srv.port,
+                                         codec="int8") as b:
+            b.pull_coded()
+            assert b.staleness() == 0
+            for _ in range(3):
+                a.push_delta(np.ones(8) * 0.01)
+            b.push_delta(np.ones(8) * 0.01)     # ack carries version 4
+            assert b.staleness() == 4           # 3 foreign + own push
+            b.pull_coded()
+            assert b.staleness() == 0
+        # the server-side gauge was fed while pushes flowed
+        assert "scaleout_staleness" in monitor.prometheus_text()
+    finally:
+        srv.close()
+
+
+def test_wire_bytes_counter_labeled_by_codec_and_direction():
+    store, srv = _server(dim=8, chunk=4)
+    wire = monitor.counter("scaleout_wire_bytes_total", "")
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="topk8") as c:
+            c.push_delta(np.arange(8.0))
+            c.pull_coded()
+        assert wire.value(dir="in", codec="topk8") > 0    # server rx
+        assert wire.value(dir="out", codec="int8") > 0    # dense pull tx
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------ trace stitching
+
+def test_retried_compressed_push_stitches_one_trace():
+    """PR-9 contract on the Z frame: the T preamble rides inside every
+    retry attempt, so a straggler's dropped-and-retried compressed push
+    records BOTH server-side spans under the caller's trace."""
+    store, srv = _server(dim=8, chunk=4)
+    ctx = monitor.TraceContext(monitor.new_trace_id(),
+                               monitor.tracer().next_span_id())
+    tok = monitor.attach(ctx)
+    try:
+        with TcpParameterServerClient(srv.host, srv.port,
+                                      codec="int8") as c:
+            c.version()
+            faults.configure(drop_connection=1)
+            c.push_delta(np.ones(8))
+    finally:
+        monitor.detach(tok)
+        srv.close()
+    trace_hex = f"{ctx.trace_id:032x}"
+    events = monitor.tracer().events(trace_id=trace_hex)
+    client_push = [e for e in events
+                   if e["name"] == "param_server_client/push"]
+    server_push = [e for e in events if e["name"] == "param_server/push"]
+    assert len(client_push) == 1
+    # the successful retry is always stitched; the first (dropped)
+    # attempt also lands when the server finished reading the frame
+    # before the teardown raced it
+    assert 1 <= len(server_push) <= 2
+    assert all(e["parent"] == client_push[0]["id"] for e in server_push)
+    # the retry actually happened (the span count alone can't prove it)
+    assert monitor.counter("param_server_retries_total",
+                           "").value() >= 1
+
+
+# ------------------------------------------- wrapper coded worker path
+
+def test_wrapper_coded_staleness_bounded_training_converges():
+    from deeplearning4j_tpu.scaleout.async_trainer import (build_net,
+                                                           eval_accuracy,
+                                                           make_batches)
+    net = build_net(seed=11, lr=0.5)
+    store = ParameterServer(net.get_flat_params(), update_scale=0.5,
+                            chunk_size=64)
+    srv = TcpParameterServer(store)
+    try:
+        psw = ParameterServerParallelWrapper(
+            net, num_workers=2, batches_per_push=2,
+            server_address=(srv.host, srv.port), codec="topk8",
+            staleness_bound=4)
+        batches = make_batches(16, 32, seed=100)
+        acc = 0.0
+        for _ in range(12):
+            psw.fit(batches)
+            acc = eval_accuracy(psw.model)
+            if acc > 0.8:
+                break
+        assert acc > 0.8, f"coded wrapper failed to converge: {acc}"
+        assert store.pushes >= 16
+        psw.close()
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------ K-process scenarios
+
+def test_async_run_two_subprocess_workers_converge():
+    from deeplearning4j_tpu.scaleout import async_trainer as at
+    rec = at.run_async(k=2, codec="topk8", rounds=10)
+    assert rec["survivors"] == 2
+    assert rec["returncodes"] == [0, 0]
+    assert rec["pushes"] == 20
+    assert rec["wire_bytes"] > 0
+    assert rec["accuracy"] > 0.70, rec
+    # every worker reported and tracked staleness from push acks
+    assert all(w["rounds"] == 10 for w in rec["workers"])
+    assert rec["staleness_max"] >= 1
+
+
+def test_async_run_survives_mid_run_worker_kill():
+    """One of K=3 workers is SIGKILLed mid-run (PR-6 preemption
+    simulator) with compression on: the run finishes, the survivors'
+    pushes keep landing, and the consolidated model still converges."""
+    from deeplearning4j_tpu.scaleout import async_trainer as at
+    rec = at.run_async(k=3, codec="topk8", rounds=8,
+                       die_at_round=(2, 3))
+    assert -9 in rec["returncodes"]
+    assert rec["survivors"] == 2
+    assert rec["pushes"] >= 16          # survivors' full complement
+    assert rec["accuracy"] > 0.65, rec

@@ -18,9 +18,14 @@ separates into per-process tracks; still-open spans from a bundle are
 rendered with an ``unfinished: true`` arg and the duration observed at
 dump time.
 
+Multiple sources merge into one timeline — each worker's events keep
+their recording ``pid``, so a K-process async run (one ``--trace-out``
+file per scaleout worker plus the server's ``D``-frame dump,
+docs/SCALEOUT.md) renders as per-process tracks sharing trace ids.
+
 Usage::
 
-    python tools/trace_view.py <bundle-dir|spans.json|trace.jsonl|URL>
+    python tools/trace_view.py <bundle-dir|spans.json|trace.jsonl|URL>...
         [-o out.trace.json]
 
 Prints a one-line summary (events, traces, pids) on success and exits
@@ -135,17 +140,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Convert a /trace dump or flight-recorder bundle "
                     "into a Perfetto/Chrome trace file.")
-    ap.add_argument("source",
-                    help="bundle dir, spans.json, /trace dump, or URL")
+    ap.add_argument("sources", nargs="+", metavar="source",
+                    help="bundle dirs, spans.json files, /trace dumps, "
+                    "or URLs — all merged into one timeline")
     ap.add_argument("-o", "--out", default=None,
-                    help="output path (default: <source>.trace.json, "
-                    "or stdout with '-')")
+                    help="output path (default: <first-source>"
+                    ".trace.json, or stdout with '-')")
     args = ap.parse_args(argv)
-    try:
-        events = load(args.source)
-    except Exception as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
+    events = []
+    for source in args.sources:
+        try:
+            events.extend(load(source))
+        except Exception as e:
+            print(f"error: {source}: {e}", file=sys.stderr)
+            return 1
     if not events:
         print("error: no trace events in source", file=sys.stderr)
         return 1
@@ -153,7 +161,7 @@ def main(argv=None) -> int:
     if args.out == "-":
         sys.stdout.write(body + "\n")
     else:
-        out = args.out or (args.source.rstrip("/") + ".trace.json")
+        out = args.out or (args.sources[0].rstrip("/") + ".trace.json")
         with open(out, "w") as f:
             f.write(body)
         print(f"wrote {out}: {summarize(events)}")
