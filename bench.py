@@ -1490,6 +1490,92 @@ def bench_scaling() -> dict:
             "detail": rep, "vs_baseline": None}
 
 
+def bench_mesh(smoke: bool = False) -> dict:
+    """Pod-runtime proof (``parallel/mesh.py`` + ``parallel/main.py``):
+    real K=2 OS-process pods over the gloo CPU fabric, one stdout JSON
+    line with the three mesh acceptance numbers the CI mesh job asserts:
+
+    - ``parity_dp_ok``: a 2-process data-parallel pod's per-step fp32
+      scores AND final param SHA-256 are bitwise identical to the
+      1-process run over the same 2-slot mesh (same shape -> same
+      program -> same bits).
+    - ``parity_zero_ok``: same bit-identity for the DP x ZeRO pod
+      (``data=1, zero=2`` — updater state sharded over ``zero``).
+    - ``updater_bytes_ratio`` / ``zero_bytes_ok``: per-process
+      addressable updater-state bytes of the ZeRO pod vs the unsharded
+      DP pod (the ``mesh_updater_state_bytes`` gauge); the gate is
+      <= 0.6x at zero_degree=2.
+
+    The full (non-smoke) run adds ``resume_ok``: SIGKILL one process at
+    step entry mid-run, relaunch the whole pod with ``--resume auto``
+    from the sharded pod checkpoint, and require the restored+resumed
+    curve and final params to match the uninterrupted pod bitwise.
+
+    Sub-run records go to stderr; stdout stays one line.
+    """
+    from deeplearning4j_tpu.parallel.main import run_pod
+
+    steps = 4 if smoke else 6
+
+    def note(tag, rec):
+        slim = {kk: rec[kk] for kk in ("k", "data", "zero", "mode",
+                                       "steps", "returncodes")}
+        slim.update({kk: rec.get(kk) for kk in ("scores", "param_sha",
+                                                "updater_state_bytes")})
+        print(json.dumps({"metric": f"mesh_{tag}", **slim}),
+              file=sys.stderr, flush=True)
+        return rec
+
+    dp2 = note("dp_k2", run_pod(k=2, data=2, mode="dp", steps=steps))
+    dp1 = note("dp_k1", run_pod(k=1, data=2, mode="dp", steps=steps))
+    z2 = note("zero_k2", run_pod(k=2, data=1, zero=2, mode="zero",
+                                 steps=steps))
+    z1 = note("zero_k1", run_pod(k=1, data=1, zero=2, mode="zero",
+                                 steps=steps))
+
+    def parity(a, b):
+        return (a["returncodes"] == [0] * a["k"]
+                and b["returncodes"] == [0] * b["k"]
+                and a.get("scores") == b.get("scores")
+                and a.get("param_sha") is not None
+                and a.get("param_sha") == b.get("param_sha"))
+
+    parity_dp_ok = parity(dp2, dp1)
+    parity_zero_ok = parity(z2, z1)
+    ratio = (z2["updater_state_bytes"] / dp2["updater_state_bytes"]
+             if dp2.get("updater_state_bytes") else None)
+    zero_bytes_ok = bool(ratio is not None and ratio <= 0.6)
+
+    resume_ok = None
+    if not smoke:
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            hurt = note("dp_killed", run_pod(
+                k=2, data=2, mode="dp", steps=steps,
+                checkpoint_dir=d, checkpoint_every=2,
+                die_at=(1, steps - 2), relaunch=True))
+            resumed = note("dp_resumed", hurt["resumed"])
+            resume_ok = (any(rc != 0 for rc in hurt["returncodes"])
+                         and resumed["returncodes"] == [0, 0]
+                         and resumed.get("scores") == dp2.get("scores")
+                         and resumed.get("param_sha") == dp2.get(
+                             "param_sha"))
+
+    ok = bool(parity_dp_ok and parity_zero_ok and zero_bytes_ok
+              and resume_ok is not False)
+    return {"metric": "mesh_pod_runtime", "value": 1 if ok else 0,
+            "unit": "ok", "smoke": smoke, "steps": steps,
+            "parity_dp_ok": parity_dp_ok,
+            "parity_zero_ok": parity_zero_ok,
+            "updater_bytes_ratio": (round(ratio, 4)
+                                    if ratio is not None else None),
+            "zero_bytes_ok": zero_bytes_ok,
+            "resume_ok": resume_ok,
+            "updater_state_bytes": {
+                "dp_k2": dp2.get("updater_state_bytes"),
+                "zero_k2": z2.get("updater_state_bytes")}}
+
+
 def _smoke_precision_fields(batch: int = 32) -> dict:
     """Precision-campaign fields for the CI perf-smoke line: the fp32
     twin's cost-model bytes, the chip-posture estimate under the
@@ -1571,6 +1657,16 @@ def main() -> None:
         # asserts value == 1.
         from deeplearning4j_tpu.resilience.chaos import run_chaos
         print(json.dumps(run_chaos(smoke="--smoke" in sys.argv)),
+              flush=True)
+        return
+    if "--mesh" in sys.argv:
+        # Pod-runtime proof: K=2 real-process pods (DP and DP x ZeRO)
+        # must be bit-identical to their 1-process runs, the ZeRO pod's
+        # per-process updater bytes must drop <= 0.6x vs unsharded, and
+        # (non-smoke) kill one process + relaunch --resume auto must
+        # match the uninterrupted curve.  One stdout JSON line; the CI
+        # mesh job asserts value == 1.
+        print(json.dumps(bench_mesh(smoke="--smoke" in sys.argv)),
               flush=True)
         return
     if "--scaleout" in sys.argv:

@@ -1,14 +1,19 @@
 """Parallelism tier (reference deeplearning4j-scaleout role, extended).
 
+- :mod:`mesh` — the pod runtime: ONE ``jax.distributed`` bootstrap and
+  ONE global ``("data", "zero", "pipe")`` device mesh shared by every
+  wrapper below (see ``docs/PARALLEL.md``).
 - :mod:`parallel_wrapper` — data parallelism with local-SGD parameter
   averaging (the reference ParallelWrapper semantics as lockstep SPMD).
 - :mod:`zero` — ZeRO-1 cross-replica weight-update sharding.
-- :mod:`pipeline` — GPipe-style pipeline parallelism over a stage axis.
+- :mod:`pipeline` — GPipe-style pipeline parallelism over the pipe axis.
 - :mod:`sequence` — ring / Ulysses / ring+flash sequence parallelism
   and the sequence-parallel LSTM scan.
 - :mod:`scaling` — 1→N scaling-efficiency harness.
+- :mod:`main` — the multi-process pod launcher CLI.
 """
 
+from .mesh import MeshRuntime, ensure_distributed  # noqa: F401
 from .parallel_wrapper import ParallelWrapper  # noqa: F401
 from .pipeline import PipelineParallel  # noqa: F401
 from .scaling import measure_throughput, scaling_report  # noqa: F401

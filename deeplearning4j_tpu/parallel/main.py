@@ -1,7 +1,7 @@
-"""ParallelWrapper CLI entry point.
+"""ParallelWrapper CLI entry point AND the multi-process pod launcher.
 
-TPU-native equivalent of the reference's
-``parallelism/main/ParallelWrapperMain.java`` (JCommander flags at
+Legacy single-process mode (TPU-native equivalent of the reference's
+``parallelism/main/ParallelWrapperMain.java``, JCommander flags at
 ``:28-70``): load a serialized model, build a ParallelWrapper from CLI
 flags, fit it from a dataset-iterator factory, optionally save the
 result and feed a remote stats UI.
@@ -10,13 +10,40 @@ Run: ``python -m deeplearning4j_tpu.parallel.main --model-path m.zip
 --iterator-factory mypkg.data:make_iterator --workers 8``
 
 The iterator factory is ``module:callable`` returning a DataSetIterator
-(the ``--dataSetIteratorFactoryClazz`` role)."""
+(the ``--dataSetIteratorFactoryClazz`` role).
+
+Pod mode (PR 11, ROADMAP item 1): one OS process per mesh slot, all
+joined into ONE ``jax.distributed`` pod by ``parallel.mesh``:
+
+- worker:  ``python -m deeplearning4j_tpu.parallel.main
+  --coordinator host:port --num-processes K --process-id i --data D
+  --zero Z --mode dp|zero --steps N``  (or the
+  ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` env
+  contract); trains the deterministic pod scenario over the shared
+  ``("data", "zero", "pipe")`` mesh, writes sharded pod checkpoints,
+  and prints exactly one JSON report line on stdout.
+- driver:  ``--spawn-local K`` forks K one-CPU-device worker
+  subprocesses on localhost (the PR-10 ``async_trainer`` harness
+  skeleton), with coordinator-port bind-retry
+  (``mesh.retry_on_port_clash``) and optional mid-run SIGKILL +
+  relaunch-with-resume (:func:`run_pod`'s ``die_at``).
+
+The scenario is seed-deterministic in BOTH data and model, so a
+K-process pod must train bit-identical (per-step fp32 scores + final
+param SHA-256) to the 1-process run of the same mesh shape — the
+acceptance gate ``bench.py --mesh`` asserts."""
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import importlib
-from typing import Optional, Sequence
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 
 def _resolve_factory(spec: str):
@@ -51,7 +78,356 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# ======================================================================
+# Pod mode: deterministic DP / DP x ZeRO trainer over the shared mesh
+# ======================================================================
+
+N_IN = 4
+N_CLASSES = 3
+
+
+def build_pod_net(seed: int = 11, lr: float = 0.05):
+    """Deterministic pod model.  Deliberately ``adam``: the updater
+    carries first/second-moment state, so the ZeRO axis has real bytes
+    to shard — with sgd the ``mesh_updater_state_bytes`` gate would be
+    vacuously true."""
+    from ..nn.conf import inputs
+    from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
+    from ..nn.layers.core import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater("adam").learning_rate(lr)
+            .activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=N_CLASSES))
+            .set_input_type(inputs.feed_forward(N_IN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_pod_batches(step: int, workers: int, batch: int,
+                     data_seed: int) -> List:
+    """The global batch for one pod step, split into ``workers``
+    per-replica DataSets.  Seeded by ``(data_seed, step)`` ONLY — every
+    process (and the 1-process parity run) generates the identical
+    global batch, which is what makes K-vs-1 bit-identity well-posed."""
+    import numpy as np
+    from ..datasets.dataset import DataSet
+
+    rng = np.random.RandomState(data_seed + step)
+    X = rng.randn(workers * batch, N_IN).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    Y = np.eye(N_CLASSES, dtype=np.float32)[y]
+    return [DataSet(X[i * batch:(i + 1) * batch],
+                    Y[i * batch:(i + 1) * batch])
+            for i in range(workers)]
+
+
+def _param_sha(net) -> str:
+    import numpy as np
+    flat = np.asarray(net.get_flat_params(), "<f4")
+    return hashlib.sha256(flat.tobytes()).hexdigest()
+
+
+def pod_worker_main(argv: Optional[Sequence[str]] = None) -> int:
+    """One pod process: join the mesh, train the deterministic scenario
+    (optionally resuming from the newest sharded pod checkpoint), print
+    one JSON report line."""
+    import numpy as np
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.parallel.main (pod worker)")
+    ap.add_argument("--pod-worker", action="store_true")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (flags > env; see "
+                         "parallel.mesh)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--data", type=int, default=None,
+                    help="data axis degree (default: fills the pod)")
+    ap.add_argument("--zero", type=int, default=1)
+    ap.add_argument("--mode", choices=("dp", "zero"), default="dp")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16,
+                    help="per-replica batch size")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--data-seed", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="pod-checkpoint every N steps (0: off)")
+    ap.add_argument("--resume", choices=("none", "auto"), default="none")
+    ap.add_argument("--measure-collectives", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..resilience import checkpoint as _ckpt
+    from ..resilience import faults as _faults
+    from .mesh import MeshRuntime
+    from .parallel_wrapper import ParallelWrapper
+    from .zero import ZeroShardedParallelWrapper
+
+    runtime = MeshRuntime(data=args.data, zero=args.zero,
+                          coordinator=args.coordinator,
+                          num_processes=args.num_processes,
+                          process_id=args.process_id)
+    net = build_pod_net(seed=args.seed, lr=args.lr)
+    if args.mode == "zero":
+        wrapper = ZeroShardedParallelWrapper(net, runtime=runtime)
+        state_axis = "zero"
+    else:
+        wrapper = ParallelWrapper(net, runtime=runtime, prefetch_size=0,
+                                  averaging_frequency=1)
+        state_axis = "data"
+    w = runtime.dp_degree
+
+    # ---- resume ---------------------------------------------------------
+    def ustate_template():
+        if args.mode == "zero":
+            return wrapper._state
+        return jax.tree.map(
+            lambda a: np.broadcast_to(np.asarray(a), (w,) + np.shape(a)),
+            net.updater_state)
+
+    start_step = 0
+    scores: List[float] = []
+    resumed_from = None
+    if args.resume == "auto" and args.checkpoint_dir:
+        restored = _ckpt.pod_restore(
+            runtime, args.checkpoint_dir,
+            {"params": net.params, "ustate": ustate_template()})
+        if restored is not None:
+            trees, manifest = restored
+            net.params = runtime.put_tree(trees["params"], P())
+            if args.mode == "zero":
+                wrapper._state = runtime.put_tree(trees["ustate"],
+                                                  P("zero"))
+            else:
+                wrapper._worker_ustate = runtime.put_tree(
+                    trees["ustate"], P(("data", "zero")))
+            extra = manifest["extra"]
+            net.iteration = int(extra["iteration"])
+            start_step = int(extra["next_step"])
+            scores = [float(s) for s in extra["scores"]]
+            resumed_from = manifest["step"]
+
+    # ---- train ----------------------------------------------------------
+    t0 = time.perf_counter()
+    ustate_bytes = 0
+    for step in range(start_step, args.steps):
+        _faults.maybe_die(step)         # PR-6 preemption simulator
+        wrapper.fit(make_pod_batches(step, w, args.batch,
+                                     args.data_seed))
+        scores.append(float(np.float32(np.asarray(net._score))))
+        ustate_bytes = runtime.publish_state_bytes(
+            wrapper._state if args.mode == "zero"
+            else wrapper._worker_ustate, axis=state_axis)
+        if (args.checkpoint_dir and args.checkpoint_every
+                and (step + 1) % args.checkpoint_every == 0):
+            _ckpt.pod_save(
+                runtime, args.checkpoint_dir, step + 1,
+                {"params": net.params,
+                 "ustate": (wrapper._state if args.mode == "zero"
+                            else wrapper._worker_ustate)},
+                extra={"next_step": step + 1,
+                       "iteration": int(net.iteration),
+                       "scores": scores, "mode": args.mode})
+            _ckpt.prune_pod_checkpoints(runtime, args.checkpoint_dir)
+    elapsed = time.perf_counter() - t0
+
+    report: Dict[str, Any] = {
+        "process_id": runtime.process_index,
+        "num_processes": runtime.process_count,
+        "topology": runtime.topology(),
+        "mode": args.mode,
+        "steps": args.steps,
+        "start_step": start_step,
+        "resumed_from": resumed_from,
+        "scores": scores,
+        "param_sha": _param_sha(net),
+        "updater_state_bytes": int(ustate_bytes),
+        "elapsed_s": round(elapsed, 3),
+    }
+    if args.measure_collectives:
+        report["collectives"] = {
+            k: round(v, 6)
+            for k, v in runtime.measure_collectives().items()}
+    runtime.barrier("pod_done")
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+# ------------------------------------------------------------ driver
+
+def _spawn_pod_worker(rank: int, k: int, port: int, *,
+                      data: int, zero: int, mode: str, steps: int,
+                      batch: int, seed: int, data_seed: int,
+                      checkpoint_dir: Optional[str],
+                      checkpoint_every: int, resume: str,
+                      die_at: Optional[tuple],
+                      measure_collectives: bool) -> subprocess.Popen:
+    from ..resilience import faults as _faults
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # one CPU device per pod process (the K x 1 topology the parity
+    # gate compares against 1 x K virtual devices)
+    devices = (data * zero) // k
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{max(1, devices)}")
+    for key in list(env):
+        if key.startswith(_faults.ENV_PREFIX):
+            del env[key]
+    if die_at is not None and die_at[0] == rank:
+        env[_faults.ENV_PREFIX + "DIE_AT_STEP"] = str(die_at[1])
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu.parallel.main",
+           "--pod-worker",
+           "--data", str(data), "--zero", str(zero),
+           "--mode", mode, "--steps", str(steps), "--batch", str(batch),
+           "--seed", str(seed), "--data-seed", str(data_seed),
+           "--resume", resume]
+    if k > 1:
+        cmd += ["--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", str(k), "--process-id", str(rank)]
+    if checkpoint_dir:
+        cmd += ["--checkpoint-dir", checkpoint_dir,
+                "--checkpoint-every", str(checkpoint_every)]
+    if measure_collectives:
+        cmd += ["--measure-collectives"]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def run_pod(k: int = 2, data: Optional[int] = None, zero: int = 1,
+            mode: str = "dp", steps: int = 8, batch: int = 16,
+            seed: int = 11, data_seed: int = 100,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 0, resume: str = "none",
+            die_at: Optional[tuple] = None, relaunch: bool = False,
+            measure_collectives: bool = False,
+            timeout: float = 420.0) -> Dict[str, Any]:
+    """Spawn a K-process local pod (one CPU device each) and collect
+    the per-process JSON reports.
+
+    ``die_at=(rank, step)`` arms ``DL4J_TPU_FAULT_DIE_AT_STEP`` in one
+    worker: it is SIGKILLed entering ``step``, the survivors hang in
+    the next collective, and the driver kills them too.  With
+    ``relaunch=True`` the whole pod is then relaunched on a FRESH
+    coordinator port with ``--resume auto`` — the resumed run must
+    replay to the same curve (the kill-parity acceptance gate)."""
+    from .mesh import is_port_clash, retry_on_port_clash
+
+    def launch(port: int):
+        procs = [_spawn_pod_worker(
+            r, k, port, data=data or k, zero=zero, mode=mode,
+            steps=steps, batch=batch, seed=seed, data_seed=data_seed,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, resume=resume,
+            die_at=die_at, measure_collectives=measure_collectives)
+            for r in range(k)]
+        outs: List[tuple] = [None] * k
+        if die_at is not None:
+            # the victim dies alone; survivors block in the next
+            # collective and must be reaped by the driver
+            victim = procs[die_at[0]]
+            try:
+                outs[die_at[0]] = victim.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                victim.kill()
+                outs[die_at[0]] = victim.communicate()
+            grace = time.monotonic() + 10.0
+            for r, p in enumerate(procs):
+                if r == die_at[0]:
+                    continue
+                while p.poll() is None and time.monotonic() < grace:
+                    time.sleep(0.1)
+                if p.poll() is None:
+                    p.kill()
+                outs[r] = p.communicate()
+        else:
+            for r, p in enumerate(procs):
+                try:
+                    outs[r] = p.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs[r] = p.communicate()
+        rcs = [p.returncode for p in procs]
+        if any(is_port_clash((o or "") + (e or ""))
+               for (o, e), rc in zip(outs, rcs) if rc != 0):
+            return False, outs
+        return True, (procs, outs, rcs)
+
+    procs, outs, rcs = retry_on_port_clash(launch)
+    reports: List[Optional[Dict[str, Any]]] = []
+    for (out, err), rc in zip(outs, rcs):
+        line = out.strip().splitlines()[-1] if out and out.strip() else ""
+        if rc == 0 and line:
+            reports.append(json.loads(line))
+        elif rc == 0:
+            raise RuntimeError(
+                f"pod worker exited 0 without a report: {err[-2000:]}")
+        else:
+            reports.append(None)
+    result: Dict[str, Any] = {
+        "k": k, "data": data or k, "zero": zero, "mode": mode,
+        "steps": steps, "batch": batch, "returncodes": rcs,
+        "reports": reports,
+        "killed": die_at is not None,
+    }
+    live = [r for r in reports if r]
+    if live:
+        result["scores"] = live[0]["scores"]
+        result["param_sha"] = live[0]["param_sha"]
+        result["updater_state_bytes"] = max(
+            r["updater_state_bytes"] for r in live)
+    if die_at is not None and relaunch:
+        resumed = run_pod(
+            k=k, data=data, zero=zero, mode=mode, steps=steps,
+            batch=batch, seed=seed, data_seed=data_seed,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, resume="auto",
+            die_at=None, measure_collectives=measure_collectives,
+            timeout=timeout)
+        result["resumed"] = resumed
+    return result
+
+
+def pod_driver_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.parallel.main (pod driver)")
+    ap.add_argument("--spawn-local", type=int, metavar="K", required=True)
+    ap.add_argument("--data", type=int, default=None)
+    ap.add_argument("--zero", type=int, default=1)
+    ap.add_argument("--mode", choices=("dp", "zero"), default="dp")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--data-seed", type=int, default=100)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--resume", choices=("none", "auto"), default="none")
+    ap.add_argument("--measure-collectives", action="store_true")
+    args = ap.parse_args(argv)
+    result = run_pod(k=args.spawn_local, data=args.data, zero=args.zero,
+                     mode=args.mode, steps=args.steps, batch=args.batch,
+                     seed=args.seed, data_seed=args.data_seed,
+                     checkpoint_dir=args.checkpoint_dir,
+                     checkpoint_every=args.checkpoint_every,
+                     resume=args.resume,
+                     measure_collectives=args.measure_collectives)
+    print(json.dumps(result, indent=2))
+    return 0 if all(rc == 0 for rc in result["returncodes"]) else 1
+
+
 def main(argv: Optional[Sequence[str]] = None):
+    argv_list = list(sys.argv[1:] if argv is None else argv)
+    if "--spawn-local" in argv_list:
+        return pod_driver_main(argv_list)
+    if "--pod-worker" in argv_list or "--coordinator" in argv_list:
+        return pod_worker_main(argv_list)
+
     from ..utils import model_serializer
     from ..utils.model_guesser import load_model_guess
     from .parallel_wrapper import ParallelWrapper

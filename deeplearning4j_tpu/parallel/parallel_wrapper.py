@@ -9,7 +9,12 @@ plus updater-state averaging (``:199-224``).
 
 TPU-first design: the whole choreography — k local steps per worker followed
 by cross-device parameter (and updater-state) averaging — compiles to ONE
-XLA program via ``jax.shard_map`` over a ``Mesh``:
+XLA program via ``jax.shard_map`` over the pod's shared
+:class:`~deeplearning4j_tpu.parallel.mesh.MeshRuntime` mesh (the legacy
+``workers=``/``devices=`` constructor builds a local ``data=w`` runtime, so
+single-process call sites are unchanged; pass ``runtime=`` to span
+processes).  Worker replicas live on the flattened ``data x zero`` extent
+of the global ``("data", "zero", "pipe")`` mesh:
 
 - worker replica  -> mesh ``data`` axis slot (ICI neighbor, not a thread)
 - round-robin     -> batch stacked (avg_freq, workers, per_worker_batch, ...)
@@ -37,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.compat import pcast as _pcast, shard_map as _shard_map
 
 from .. import monitor as _monitor
+from .mesh import MeshRuntime
 from ..datasets.dataset import DataSet
 from ..nn.multilayer import MultiLayerNetwork
 
@@ -52,22 +58,35 @@ class ParallelWrapper:
     def __init__(self, model, workers: Optional[int] = None,
                  averaging_frequency: int = 1, average_updaters: bool = True,
                  report_score: bool = False, prefetch_size: int = 2,
-                 devices: Optional[list] = None):
+                 devices: Optional[list] = None,
+                 runtime: Optional[MeshRuntime] = None):
         from ..nn.computation_graph import ComputationGraph
         self.model = model
         self._is_graph = isinstance(model, ComputationGraph)
-        self.devices = devices if devices is not None else jax.devices()
-        self.workers = workers or len(self.devices)
-        if self.workers > len(self.devices):
-            raise ValueError(
-                f"{self.workers} workers > {len(self.devices)} devices")
+        if runtime is None:
+            self.devices = devices if devices is not None else jax.devices()
+            self.workers = workers or len(self.devices)
+            if self.workers > len(self.devices):
+                raise ValueError(
+                    f"{self.workers} workers > {len(self.devices)} devices")
+            runtime = MeshRuntime.local(data=self.workers,
+                                        devices=self.devices)
+        else:
+            if runtime.pipe_degree != 1:
+                raise ValueError(
+                    "ParallelWrapper runs on the data x zero extent; got "
+                    f"a runtime with pipe={runtime.pipe_degree} (compose "
+                    "pipeline via PipelineParallel)")
+            self.devices = list(runtime.devices)
+            # every data x zero slot is a DP worker replica
+            self.workers = runtime.dp_degree
+        self.runtime = runtime
         self.averaging_frequency = max(1, averaging_frequency)
         self.average_updaters = average_updaters
         self.report_score = report_score
         self.prefetch_size = prefetch_size
-        self.mesh = Mesh(
-            np.array(self.devices[:self.workers]).reshape(self.workers),
-            ("data",))
+        self.mesh = runtime.mesh
+        self._dp = ("data", "zero")  # the flattened worker extent
         self.listeners: List[Any] = []
         self._worker_ustate = None  # stacked (workers, ...) across rounds
         self.skipped_tail_batches = 0  # stragglers left unfitted (ref parity)
@@ -122,6 +141,8 @@ class ParallelWrapper:
                  and net.conf.backprop_type == "tbptt")
         from ..monitor import health as _health
         horder = list(net._layer_names()) if self._is_graph else None
+        dp = self._dp  # worker extent: flattened ("data", "zero")
+        zero_n = self.runtime.zero_degree
 
         def local_round(params, updater_state, net_state, iteration,
                         features, labels, fmask, lmask, base_rng, wire):
@@ -137,14 +158,19 @@ class ParallelWrapper:
             fmask = jax.tree.map(lambda a: a[:, 0], fmask)
             lmask = jax.tree.map(lambda a: a[:, 0], lmask)
             updater_state = jax.tree.map(lambda a: a[0], updater_state)
-            widx = lax.axis_index("data")
+            # Combined worker index over the flattened data x zero extent
+            # (lax.axis_index takes a single name on this JAX).  Row-major
+            # over the mesh layout, so rng streams match the legacy
+            # one-axis ("data",) mesh ordering for any (data, zero) split.
+            widx = lax.axis_index("data") * zero_n + lax.axis_index("zero")
             # Mark replicated state as device-varying: each worker steps its
             # own copy independently.  Without this, shard_map's replication
             # tracking auto-psums gradients taken w.r.t. unvarying params
             # (allreduce-SGD), which is NOT the reference's local-step-then-
             # average semantics.
-            params, net_state = _pcast((params, net_state), "data",
-                                          to="varying")
+            for ax in dp:
+                params, net_state = _pcast((params, net_state), ax,
+                                           to="varying")
 
             def one_step(carry, batch):
                 from ..nn import ingest
@@ -221,26 +247,27 @@ class ParallelWrapper:
                 one_step, (params, updater_state, net_state, iteration),
                 (features, labels, fmask, lmask))
             # averageAndPropagate: params always, updater state if enabled
-            params = lax.pmean(params, "data")
+            params = lax.pmean(params, dp)
             if avg_updaters:
-                updater_state = lax.pmean(updater_state, "data")
-                updater_state = _pcast(updater_state, "data",
-                                          to="varying")
-            net_state = lax.pmean(net_state, "data")
-            score = lax.pmean(jnp.mean(scores), "data")
+                updater_state = lax.pmean(updater_state, dp)
+                for ax in dp:
+                    updater_state = _pcast(updater_state, ax,
+                                           to="varying")
+            net_state = lax.pmean(net_state, dp)
+            score = lax.pmean(jnp.mean(scores), dp)
             # Mean across workers: a single worker's NaN poisons the
             # averaged vector and the 0/1 flag column stays > 0 iff any
             # worker flagged — the pmean'd stack still decodes.
-            health = lax.pmean(hstack, "data")
+            health = lax.pmean(hstack, dp)
             # updater state stays per-worker (stacked) across rounds
             updater_state = jax.tree.map(lambda a: a[None], updater_state)
             return params, updater_state, net_state, score, health
 
         mesh = self.mesh
-        in_specs = (P(), P("data"), P(), P(), P(None, "data"),
-                    P(None, "data"), P(None, "data"), P(None, "data"), P(),
+        in_specs = (P(), P(dp), P(), P(), P(None, dp),
+                    P(None, dp), P(None, dp), P(None, dp), P(),
                     P())
-        out_specs = (P(), P("data"), P(), P(), P())
+        out_specs = (P(), P(dp), P(), P(), P())
         fn = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
         return _monitor.watched_jit(fn, name="parallel.step",
@@ -431,14 +458,16 @@ class ParallelWrapper:
             labs = stack(lambda ds: ds.labels)
             fmask = stack_masks(lambda ds: ds.features_mask)
             lmask = stack_masks(lambda ds: ds.labels_mask)
-        # shard the worker axis (axis 1) over the mesh
-        sharding = NamedSharding(self.mesh, P(None, "data"))
-        feats = jax.device_put(jax.tree.map(jnp.asarray, feats), sharding)
-        labs = jax.device_put(jax.tree.map(jnp.asarray, labs), sharding)
+        # shard the worker axis (axis 1) over the flattened data x zero
+        # extent; runtime.put_tree stages process-spanning shardings via
+        # make_array_from_callback where plain device_put cannot
+        spec = P(None, self._dp)
+        feats = self.runtime.put_tree(feats, spec)
+        labs = self.runtime.put_tree(labs, spec)
         if fmask is not None:
-            fmask = jax.device_put(jax.tree.map(jnp.asarray, fmask), sharding)
+            fmask = self.runtime.put_tree(fmask, spec)
         if lmask is not None:
-            lmask = jax.device_put(jax.tree.map(jnp.asarray, lmask), sharding)
+            lmask = self.runtime.put_tree(lmask, spec)
         _monitor.gauge(
             "ingest_staged_bytes",
             "bytes uploaded to the device per staging event").set(
@@ -457,12 +486,12 @@ class ParallelWrapper:
         if self._worker_ustate is None:
             # Replicate the model's updater state to every worker (the
             # reference's per-worker model replication at Trainer start).
-            self._worker_ustate = jax.device_put(
+            self._worker_ustate = self.runtime.put_tree(
                 jax.tree.map(
-                    lambda a: jnp.broadcast_to(a[None],
-                                               (w,) + a.shape),
+                    lambda a: np.broadcast_to(np.asarray(a),
+                                              (w,) + np.shape(a)),
                     net.updater_state),
-                NamedSharding(self.mesh, P("data")))
+                P(self._dp))
         t1 = time.perf_counter()
         (net.params, self._worker_ustate, net.net_state,
          score, health) = self._parallel_step(
@@ -477,8 +506,13 @@ class ParallelWrapper:
                          "per-replica local train steps across all "
                          "workers").inc(k * w)
         # Keep the model's own updater state in sync (worker 0's replica —
-        # identical across workers when average_updaters is on).
-        net.updater_state = jax.tree.map(lambda a: a[0], self._worker_ustate)
+        # identical across workers when average_updaters is on).  When the
+        # worker extent spans processes, row 0 may not be addressable here;
+        # pod checkpoints read the sharded stack directly instead.
+        if not self.runtime.is_multiprocess:
+            net.updater_state = jax.tree.map(lambda a: a[0],
+                                             self._worker_ustate)
+        self.runtime.publish_state_bytes(self._worker_ustate, axis="data")
         net.iteration += k
         net._score = score
         self.last_score = float(score) if self.report_score else None

@@ -1,4 +1,4 @@
-"""Pipeline parallelism (GPipe-style) over a ``stage`` mesh axis.
+"""Pipeline parallelism (GPipe-style) over the pod mesh's ``pipe`` axis.
 
 The 2016 reference has no pipeline parallelism (its only axis is data
 parallelism); this is the TPU-native pipeline tier completing the
@@ -7,7 +7,8 @@ sp: ``sequence``, pp: here).
 
 Design: the layer stack is partitioned into S contiguous stages; a
 minibatch is split into M microbatches; inside ONE ``shard_map``-ed XLA
-program over the ``stage`` axis, a ``lax.scan`` runs ``M + S - 1``
+program over the shared :class:`~deeplearning4j_tpu.parallel.mesh.MeshRuntime`
+mesh's ``pipe`` axis, a ``lax.scan`` runs ``M + S - 1``
 ticks.  At tick t, stage s processes microbatch ``t - s`` (when in
 range): stage 0 feeds fresh microbatches, every stage hands its
 activation to stage s+1 via ``lax.ppermute``, and the last stage's
@@ -40,10 +41,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from ..ops.compat import shard_map as _shard_map
 
 from ..datasets.dataset import DataSet
+from .mesh import MeshRuntime
 
 Array = jax.Array
 
@@ -85,24 +87,36 @@ class PipelineParallel:
     """
 
     def __init__(self, model, stages: Optional[int] = None,
-                 microbatches: int = 4, devices: Optional[list] = None):
+                 microbatches: int = 4, devices: Optional[list] = None,
+                 runtime: Optional[MeshRuntime] = None):
         from ..nn.multilayer import MultiLayerNetwork
         if not isinstance(model, MultiLayerNetwork):
             raise ValueError("PipelineParallel supports MultiLayerNetwork")
         self.model = model
         model.init()
-        self.devices = devices if devices is not None else jax.devices()
-        self.stages = stages or len(self.devices)
-        if self.stages > len(self.devices):
-            raise ValueError(
-                f"{self.stages} stages > {len(self.devices)} devices")
+        if runtime is None:
+            self.devices = devices if devices is not None else jax.devices()
+            self.stages = stages or len(self.devices)
+            if self.stages > len(self.devices):
+                raise ValueError(
+                    f"{self.stages} stages > {len(self.devices)} devices")
+            runtime = MeshRuntime.local(pipe=self.stages,
+                                        devices=self.devices)
+        else:
+            if runtime.data_degree != 1 or runtime.zero_degree != 1:
+                raise ValueError(
+                    "PipelineParallel runs on the pipe axis; got a runtime "
+                    f"with data={runtime.data_degree} "
+                    f"zero={runtime.zero_degree} (compose DP via "
+                    "ParallelWrapper/ZeroShardedParallelWrapper)")
+            self.devices = list(runtime.devices)
+            self.stages = runtime.pipe_degree
         if self.stages > len(model.layers):
             raise ValueError(
                 f"{self.stages} stages > {len(model.layers)} layers")
+        self.runtime = runtime
         self.microbatches = microbatches
-        self.mesh = Mesh(
-            np.array(self.devices[:self.stages]).reshape(self.stages),
-            ("stage",))
+        self.mesh = runtime.mesh
         self._validate()
         self.ranges = partition_stages(model.layers, model.params,
                                        self.stages)
@@ -191,9 +205,9 @@ class PipelineParallel:
         stage_fns = [stage_fn(s) for s in range(S)]
 
         def pipeline_loss(params, x_mb, y_mb):
-            """Inside shard_map over ("stage",): x_mb (M, mb, W) padded
+            """Inside shard_map over the pipe axis: x_mb (M, mb, W) padded
             microbatch features, y_mb (M, mb, out_width) labels."""
-            s = lax.axis_index("stage")
+            s = lax.axis_index("pipe")
             mb = x_mb.shape[1]
 
             def tick(buf, t):
@@ -205,11 +219,11 @@ class PipelineParallel:
                 my_mb = t - s
                 active = (my_mb >= 0) & (my_mb < M)
                 y = jnp.where(active, y, 0.0)
-                handed = lax.ppermute(y, "stage",
+                handed = lax.ppermute(y, "pipe",
                                       [(i, (i + 1) % S) for i in range(S)])
                 # collect the LAST stage's finished microbatch
                 out_t = jnp.where((s == S - 1) & active, y, 0.0)
-                out_t = lax.psum(out_t, "stage")
+                out_t = lax.psum(out_t, "pipe")
                 return handed, out_t
 
             buf0 = jnp.zeros((mb, W), x_mb.dtype)
@@ -234,7 +248,7 @@ class PipelineParallel:
             # zeros elsewhere.  psum collects the owner contributions
             # (others add zero) and the 1/S normalizes the inflation —
             # verified against serial grads for S=2 and S=4.
-            grads = jax.tree.map(lambda g: lax.psum(g, "stage") / S, grads)
+            grads = jax.tree.map(lambda g: lax.psum(g, "pipe") / S, grads)
             new_params, new_ustate = net._apply_updates(
                 params, updater_state, grads, iteration)
             score = loss + net._reg_score(params)

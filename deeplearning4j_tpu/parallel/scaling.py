@@ -136,9 +136,10 @@ def collective_overhead_report(net_factory: Callable[[], object],
               net2.net_state]
 
     def pw_dispatch():
-        (wstate[0], wstate[1], wstate[2], score) = pw._parallel_step(
+        (wstate[0], wstate[1], wstate[2], score,
+         _health) = pw._parallel_step(
             wstate[0], wstate[1], wstate[2], 0, fs, ls, None, None,
-            net2._rng_key)
+            net2._rng_key, None)
         return score
 
     float(np.asarray(pw_dispatch()))

@@ -12,10 +12,20 @@ memory and update FLOPs drop n-fold; semantics are bit-identical to
 replicated data parallelism.
 
 TPU-first shape: the whole step (forward, backward, psum, sharded
-update, all-gather) is ONE ``shard_map``-ed XLA program over the
-``data`` mesh axis; the reference (2016 DL4J) has no analogue — its
-ParallelWrapper replicates updater state per worker
-(``ParallelWrapper.java:199-224`` averages it, this shards it).
+update, all-gather) is ONE ``shard_map``-ed XLA program over the shared
+:class:`~deeplearning4j_tpu.parallel.mesh.MeshRuntime` mesh; the
+reference (2016 DL4J) has no analogue — its ParallelWrapper replicates
+updater state per worker (``ParallelWrapper.java:199-224`` averages it,
+this shards it).
+
+Axis composition (DP x ZeRO): batches shard over the FLATTENED
+``data x zero`` extent (every mesh slot is a batch replica), but the
+updater state — moment rows and fp32 masters — shards over ``zero``
+ONLY and is replicated over ``data``.  Per-process optimizer-state
+residency therefore drops ~``1/zero_degree`` even when ``zero`` spans
+OS processes (the paper's memory win at pod scale).  The legacy
+``workers=w`` constructor maps to ``MeshRuntime.local(zero=w)``
+(data=1), which reproduces the old single-axis semantics exactly.
 
 Scope (raise, don't silently diverge): one network-wide updater config
 (per-layer updater overrides would need per-element kind vectors),
@@ -35,11 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from ..ops.compat import pcast as _pcast, shard_map as _shard_map
 
 from ..datasets.dataset import DataSet
 from ..nn import updaters as U
+from .mesh import MeshRuntime
 
 Array = jax.Array
 
@@ -52,21 +63,34 @@ class ZeroShardedParallelWrapper:
     ``averaging_frequency=1`` regime it replaces."""
 
     def __init__(self, model, workers: Optional[int] = None,
-                 devices: Optional[list] = None):
+                 devices: Optional[list] = None,
+                 runtime: Optional[MeshRuntime] = None):
         from ..nn.multilayer import MultiLayerNetwork
         if not isinstance(model, MultiLayerNetwork):
             raise ValueError("ZeRO sharding currently supports "
                              "MultiLayerNetwork")
         self.model = model
         model.init()
-        self.devices = devices if devices is not None else jax.devices()
-        self.workers = workers or len(self.devices)
-        if self.workers > len(self.devices):
-            raise ValueError(
-                f"{self.workers} workers > {len(self.devices)} devices")
-        self.mesh = Mesh(
-            np.array(self.devices[:self.workers]).reshape(self.workers),
-            ("data",))
+        if runtime is None:
+            self.devices = devices if devices is not None else jax.devices()
+            workers = workers or len(self.devices)
+            if workers > len(self.devices):
+                raise ValueError(
+                    f"{workers} workers > {len(self.devices)} devices")
+            # legacy single-axis semantics: every worker is a zero shard
+            runtime = MeshRuntime.local(zero=workers, devices=self.devices)
+        else:
+            if runtime.pipe_degree != 1:
+                raise ValueError(
+                    "ZeRO sharding runs on the data x zero extent; got a "
+                    f"runtime with pipe={runtime.pipe_degree}")
+            self.devices = list(runtime.devices)
+        self.runtime = runtime
+        self.mesh = runtime.mesh
+        # batch replicas = every data x zero slot; state shards = zero only
+        self.workers = runtime.dp_degree
+        self.zero_n = runtime.zero_degree
+        self._dp = ("data", "zero")
         self._validate()
         self._build()
 
@@ -101,7 +125,7 @@ class ZeroShardedParallelWrapper:
         _, self._unravel_f32 = ravel_pytree(jax.tree.map(
             lambda a: jnp.zeros(a.shape, jnp.float32), net.params))
         self.total = flat.shape[0]
-        n = self.workers
+        n = self.zero_n
         self.shard = -(-self.total // n)          # ceil
         self.padded = self.shard * n
         # state keys from the ONE source of truth (updaters.init_state),
@@ -109,26 +133,29 @@ class ZeroShardedParallelWrapper:
         state_keys = U.init_state(self.uconf,
                                   jnp.zeros((1,), jnp.float32)).keys()
         sdtype = jnp.dtype(pol.updater_dtype)
-        state = {k: jnp.zeros((n, self.shard), sdtype) for k in state_keys}
+        state = {k: np.zeros((n, self.shard), sdtype) for k in state_keys}
         self._masters = bool(
             pol.master_weights and self._flat_dtype.itemsize < 4)
         if self._masters:
             # the fp32 master shard IS part of the sharded state: each
             # replica owns 1/n of the masters, exactly the setting of the
             # cross-replica weight-update sharding paper (arXiv:2004.13336)
-            state[U.MASTER_KEY] = jnp.pad(
-                flat.astype(jnp.float32),
+            state[U.MASTER_KEY] = np.pad(
+                np.asarray(flat, dtype=np.float32),
                 (0, self.padded - self.total)).reshape(n, self.shard)
-        # per-replica updater state: ONE shard each (the n-fold saving)
-        self._state = jax.device_put(
-            state, NamedSharding(self.mesh, P("data")))
+        # per-zero-shard updater state: ONE shard each (the n-fold
+        # saving), replicated over the data axis and — when zero spans
+        # processes — resident only 1/n per process
+        self._state = self.runtime.put_tree(state, P("zero"))
+        self.runtime.publish_state_bytes(self._state, axis="zero")
 
     # ------------------------------------------------------------ the step
     @functools.cached_property
     def _step(self):
         net = self.model
         uconf = self.uconf
-        n = self.workers
+        zero_n = self.zero_n
+        dp = self._dp
         shard, total, padded = self.shard, self.total, self.padded
         unravel = self._unravel
 
@@ -146,9 +173,16 @@ class ZeroShardedParallelWrapper:
             # varying params -> per-replica grads + EXPLICIT pmean below
             # (unvarying params would make shard_map auto-psum the grads,
             # i.e. SUM not MEAN — the ParallelWrapper pattern)
-            params, net_state = _pcast((params, net_state), "data",
-                                          to="varying")
-            widx = lax.axis_index("data")
+            for ax in dp:
+                params, net_state = _pcast((params, net_state), ax,
+                                           to="varying")
+            # combined batch-replica index over the flattened data x zero
+            # extent (matches the legacy single-axis ordering when data=1)
+            widx = lax.axis_index("data") * zero_n + lax.axis_index("zero")
+            # which 1/zero_n slice of the flat update this slot owns —
+            # identical across the data axis, so each update is computed
+            # once per zero shard and the all-gather reassembles it
+            zidx = lax.axis_index("zero")
             rng = jax.random.fold_in(rng, widx)    # decorrelate dropout
             (data_loss, aux), grads = jax.value_and_grad(
                 net._loss_fn, has_aux=True)(
@@ -163,11 +197,11 @@ class ZeroShardedParallelWrapper:
                 wgt = jnp.sum(fm).astype(jnp.float32)
             else:
                 wgt = jnp.float32(1.0)
-            wsum = lax.psum(wgt, "data")
+            wsum = lax.psum(wgt, dp)
             grads = jax.tree.map(
-                lambda g: lax.psum(g * wgt, "data") / wsum, grads)
-            new_net_state = lax.pmean(new_net_state, "data")
-            score = lax.psum(data_loss * wgt, "data") / wsum + reg
+                lambda g: lax.psum(g * wgt, dp) / wsum, grads)
+            new_net_state = lax.pmean(new_net_state, dp)
+            score = lax.psum(data_loss * wgt, dp) / wsum + reg
             # EXACT replicated-path order (updaters.apply_layer_updates):
             # l1/l2 into the grads FIRST, then per-layer normalization,
             # then the (sharded) updater transform
@@ -190,7 +224,7 @@ class ZeroShardedParallelWrapper:
             flat_p, _ = ravel_pytree(params)
             flat_g = jnp.pad(flat_g, (0, padded - total))
             flat_p_pad = jnp.pad(flat_p, (0, padded - total))
-            start = widx * shard
+            start = zidx * shard
             my_g = lax.dynamic_slice(flat_g, (start,), (shard,))
             my_p = lax.dynamic_slice(flat_p_pad, (start,), (shard,))
             state_shard = dict(state_shard)
@@ -215,17 +249,25 @@ class ZeroShardedParallelWrapper:
 
         sharded = _shard_map(
             zero_step, mesh=self.mesh,
-            in_specs=(P(), P("data"), P(), P(), P("data"), P("data"),
-                      P("data"), P("data"), P()),
-            out_specs=(P("data"), P("data"), P(), P()))
+            in_specs=(P(), P("zero"), P(), P(), P(dp), P(dp),
+                      P(dp), P(dp), P()),
+            out_specs=(P("zero"), P("zero"), P(), P()))
+
+        replicated = self.runtime.sharding(P())
 
         def step(params, state, net_state, iteration, feats, labs,
                  fmask, lmask, rng):
             new_flat, new_state, new_net_state, score = sharded(
                 params, state, net_state, iteration, feats, labs,
                 fmask, lmask, rng)
-            return (unravel(new_flat[:total]), new_state, new_net_state,
-                    score)
+            new_params = unravel(new_flat[:total])
+            # pin the reassembled params to replicated: without this the
+            # compiler may leave them zero-partitioned, and a
+            # process-spanning pod could never fetch them whole
+            # (get_flat_params / serialization / the parity SHA)
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, replicated)
+            return new_params, new_state, new_net_state, score
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -256,6 +298,10 @@ class ZeroShardedParallelWrapper:
         net = self.model
         if not self._state:
             return                      # stateless updater (sgd/none)
+        if self.runtime.is_multiprocess:
+            # the full state is not addressable from any one process;
+            # pod checkpoints persist the sharded stack directly instead
+            return
         per_key = {}
         for key, sharded in self._state.items():
             flat = np.asarray(sharded).reshape(-1)[:self.total]
@@ -270,11 +316,11 @@ class ZeroShardedParallelWrapper:
     def _run_step(self, batches: List[DataSet]) -> None:
         net = self.model
         b = min(ds.num_examples() for ds in batches)
-        sharding = NamedSharding(self.mesh, P("data"))
+        spec = P(self._dp)
 
         def stack(get):
-            return jax.device_put(jnp.asarray(np.stack(
-                [np.asarray(get(ds))[:b] for ds in batches])), sharding)
+            return self.runtime.put(np.stack(
+                [np.asarray(get(ds))[:b] for ds in batches]), spec)
 
         def stack_masks(get):
             present = [get(ds) is not None for ds in batches]
@@ -296,6 +342,7 @@ class ZeroShardedParallelWrapper:
             feats, labs, fmask, lmask, rng)
         net.iteration += 1
         net._score = score
+        self.runtime.publish_state_bytes(self._state, axis="zero")
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration)
 

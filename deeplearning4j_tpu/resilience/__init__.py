@@ -26,11 +26,14 @@ pushes) lives with its transport in ``scaleout/param_server.py`` and
 
 from . import faults
 from .checkpoint import (CheckpointCorruptError, CheckpointManager,
-                         ResumeState, as_manager, list_checkpoints, restore,
-                         verify_checkpoint)
+                         ResumeState, as_manager, list_checkpoints,
+                         list_pod_checkpoints, pod_restore, pod_save,
+                         prune_pod_checkpoints, restore, verify_checkpoint,
+                         verify_pod_checkpoint)
 
 __all__ = [
     "CheckpointCorruptError", "CheckpointManager", "ResumeState",
-    "as_manager", "faults", "list_checkpoints", "restore",
-    "verify_checkpoint",
+    "as_manager", "faults", "list_checkpoints", "list_pod_checkpoints",
+    "pod_restore", "pod_save", "prune_pod_checkpoints", "restore",
+    "verify_checkpoint", "verify_pod_checkpoint",
 ]

@@ -635,6 +635,305 @@ def resume_for_fit(net, resume_from,
     return restore(net, resume_from)
 
 
+# ======================================================================
+# Pod (multi-process, sharded) checkpoints
+#
+# A pod checkpoint is a DIRECTORY ``pod-<step>/`` under the checkpoint
+# root, written cooperatively by every process of a
+# ``parallel.mesh.MeshRuntime`` pod:
+#
+# - each process atomically writes ``shard-<pid>.zip`` holding its
+#   addressable, per-process-deduplicated array shards (raw bytes plus
+#   a ``shards.json`` table with global shape / dtype / index windows /
+#   SHA-256 per entry);
+# - all processes barrier;
+# - process 0 writes ``pod-manifest.json`` LAST (atomic rename),
+#   stamping the mesh topology and the SHA-256 of every shard file.
+#
+# The manifest-last ordering is the kill-safety invariant: a complete
+# manifest implies every shard is durable, so a SIGKILL at ANY instant
+# leaves either a fully valid pod checkpoint or an ignorable partial
+# directory.  Restore refuses a topology mismatch (a 2x1 pod must not
+# misassemble a 1x2 checkpoint) and re-verifies every hash.
+#
+# For ``--spawn-local`` pods the directory is trivially shared; real
+# multi-host pods need it on shared storage (NFS/GCS-fuse), the usual
+# pod-checkpoint contract.
+# ======================================================================
+
+POD_PREFIX = "pod-"
+POD_MANIFEST = "pod-manifest.json"
+POD_SHARDS_JSON = "shards.json"
+
+
+def pod_checkpoint_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"{POD_PREFIX}{int(step):010d}")
+
+
+def _pod_step_of(name: str) -> Optional[int]:
+    if not name.startswith(POD_PREFIX):
+        return None
+    try:
+        return int(name[len(POD_PREFIX):])
+    except ValueError:
+        return None
+
+
+def list_pod_checkpoints(directory: str) -> List[str]:
+    """Pod checkpoint directories under ``directory`` that have a
+    manifest (i.e. completed the two-phase write), newest first."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    out = [(st, os.path.join(directory, n)) for n in names
+           if (st := _pod_step_of(n)) is not None
+           and os.path.exists(os.path.join(directory, n, POD_MANIFEST))]
+    return [p for _, p in sorted(out, reverse=True)]
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(directory,
+                       f".tmp-{os.path.basename(path)}.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def _leaf_shards(leaf):
+    """(global_shape, dtype, [(index_windows, host_array), ...]) for one
+    jax array leaf — this process's addressable shards, deduplicated (a
+    leaf replicated across local devices contributes one copy)."""
+    if not hasattr(leaf, "addressable_shards"):
+        arr = np.asarray(leaf)
+        full = tuple((0, s) for s in arr.shape)
+        return arr.shape, arr.dtype, [(full, arr)]
+    shape = tuple(leaf.shape)
+    out, seen = [], set()
+    for s in leaf.addressable_shards:
+        windows = tuple(
+            (sl.start or 0, sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(s.index, shape))
+        if windows in seen:
+            continue
+        seen.add(windows)
+        out.append((windows, np.asarray(s.data)))
+    return shape, np.dtype(leaf.dtype), out
+
+
+def pod_save(runtime, directory: str, step: int, trees: Dict[str, Any],
+             extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write one pod checkpoint of ``trees`` (a dict of named pytrees —
+    params / updater state / net state, possibly process-spanning
+    sharded) at ``step``.  Collective: EVERY process of the pod must
+    call this with the same arguments.  Returns the pod directory."""
+    import jax
+    pdir = pod_checkpoint_dir(directory, step)
+    os.makedirs(pdir, exist_ok=True)
+    pid = runtime.process_index
+    table: List[Dict[str, Any]] = []
+    payload: List[Tuple[str, bytes]] = []
+    for name in sorted(trees):
+        leaves = jax.tree_util.tree_leaves(trees[name])
+        for li, leaf in enumerate(leaves):
+            shape, dtype, shards = _leaf_shards(leaf)
+            for si, (windows, arr) in enumerate(shards):
+                entry = f"data/{name}/{li}/{si}"
+                data = np.ascontiguousarray(arr).tobytes()
+                payload.append((entry, data))
+                table.append({
+                    "key": name, "leaf": li, "entry": entry,
+                    "global_shape": list(shape), "dtype": str(dtype),
+                    "windows": [list(w) for w in windows],
+                    "sha256": _sha256(data), "size": len(data),
+                })
+    import io
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for entry, data in payload:
+            zf.writestr(entry, data)
+        zf.writestr(POD_SHARDS_JSON, json.dumps(
+            {"process": pid, "shards": table}, indent=2))
+    shard_name = f"shard-{pid:05d}.zip"
+    _atomic_write_bytes(os.path.join(pdir, shard_name), buf.getvalue())
+    # every shard durable before the manifest stamps the set complete
+    runtime.barrier(f"pod_save:{step}")
+    if pid == 0:
+        files = {}
+        for i in range(runtime.process_count):
+            fname = f"shard-{i:05d}.zip"
+            with open(os.path.join(pdir, fname), "rb") as fh:
+                data = fh.read()
+            files[fname] = {"sha256": _sha256(data), "size": len(data)}
+        manifest = {
+            "framework": "deeplearning4j_tpu",
+            "kind": "pod_checkpoint",
+            "step": int(step),
+            "topology": runtime.topology(),
+            "trees": sorted(trees),
+            "extra": extra or {},
+            "wall_time": time.time(),
+            "files": files,
+        }
+        _atomic_write_bytes(os.path.join(pdir, POD_MANIFEST),
+                            json.dumps(manifest, indent=2).encode("utf-8"))
+        _monitor.counter(WRITES_TOTAL, _HELP[WRITES_TOTAL]).inc()
+        _monitor.gauge(BYTES_GAUGE, _HELP[BYTES_GAUGE]).set(
+            sum(f["size"] for f in files.values()))
+    # no process may start mutating donated buffers (or pruning) until
+    # the manifest is durable
+    runtime.barrier(f"pod_manifest:{step}")
+    return pdir
+
+
+def verify_pod_checkpoint(pdir: str,
+                          topology: Optional[Dict[str, int]] = None
+                          ) -> Dict[str, Any]:
+    """Verify a pod checkpoint directory: manifest present, every shard
+    file present with matching SHA-256/size, and (when ``topology`` is
+    given) an exact mesh-shape match.  Returns the manifest."""
+    mpath = os.path.join(pdir, POD_MANIFEST)
+    if not os.path.exists(mpath):
+        raise CheckpointCorruptError(
+            f"{pdir}: no {POD_MANIFEST} — incomplete pod checkpoint "
+            "(a process died before the manifest was stamped)")
+    try:
+        with open(mpath, "rb") as fh:
+            manifest = json.loads(fh.read())
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            f"{pdir}: unreadable {POD_MANIFEST}: {e}") from e
+    if topology is not None and manifest.get("topology") != topology:
+        raise CheckpointCorruptError(
+            f"{pdir}: checkpoint topology {manifest.get('topology')} != "
+            f"this pod's {topology}; refusing to misassemble — relaunch "
+            "with the recorded mesh shape")
+    for fname, ent in manifest.get("files", {}).items():
+        fpath = os.path.join(pdir, fname)
+        if not os.path.exists(fpath):
+            raise CheckpointCorruptError(
+                f"{pdir}: manifest lists {fname} but it is missing")
+        with open(fpath, "rb") as fh:
+            data = fh.read()
+        if len(data) != int(ent["size"]) or _sha256(data) != ent["sha256"]:
+            raise CheckpointCorruptError(
+                f"{pdir}: {fname} fails size/SHA-256 verification — torn "
+                "write or bit rot; refusing to load")
+    return manifest
+
+
+def pod_restore(runtime, directory: str,
+                templates: Dict[str, Any],
+                step: Optional[int] = None
+                ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any]]]:
+    """Restore the newest (or ``step``-specified) pod checkpoint under
+    ``directory`` into HOST pytrees shaped like ``templates`` (same
+    names and tree structures used at :func:`pod_save` time).  Every
+    process reads all shard files and reassembles the full global
+    arrays — the caller re-stages them onto the mesh with its own
+    sharding specs.  Returns ``(trees, manifest)`` or ``None`` when no
+    completed pod checkpoint exists (cold start)."""
+    import jax
+    if step is not None:
+        candidates = [pod_checkpoint_dir(directory, step)]
+        if not os.path.exists(os.path.join(candidates[0], POD_MANIFEST)):
+            raise FileNotFoundError(
+                f"no completed pod checkpoint at step {step} under "
+                f"{directory}")
+    else:
+        candidates = list_pod_checkpoints(directory)
+    for pdir in candidates:
+        try:
+            manifest = verify_pod_checkpoint(pdir, runtime.topology())
+        except CheckpointCorruptError:
+            _monitor.counter(CORRUPT_SKIPPED, _HELP[CORRUPT_SKIPPED]).inc()
+            if step is not None:
+                raise
+            continue
+        # key -> leaf index -> np buffer, filled window by window
+        bufs: Dict[Tuple[str, int], np.ndarray] = {}
+        filled: Dict[Tuple[str, int], int] = {}
+        for fname in sorted(manifest["files"]):
+            with zipfile.ZipFile(os.path.join(pdir, fname), "r") as zf:
+                table = json.loads(zf.read(POD_SHARDS_JSON))["shards"]
+                for ent in table:
+                    k = (ent["key"], int(ent["leaf"]))
+                    shape = tuple(ent["global_shape"])
+                    if k not in bufs:
+                        bufs[k] = np.empty(shape, np.dtype(ent["dtype"]))
+                        filled[k] = 0
+                    data = zf.read(ent["entry"])
+                    if _sha256(data) != ent["sha256"]:
+                        raise CheckpointCorruptError(
+                            f"{pdir}/{fname}: {ent['entry']} SHA-256 "
+                            "mismatch")
+                    windows = tuple(tuple(w) for w in ent["windows"])
+                    view = np.frombuffer(
+                        data, np.dtype(ent["dtype"])).reshape(
+                        [b - a for a, b in windows])
+                    idx = tuple(slice(a, b) for a, b in windows)
+                    bufs[k][idx] = view
+                    filled[k] += view.size
+        trees: Dict[str, Any] = {}
+        for name in sorted(templates):
+            leaves, treedef = jax.tree_util.tree_flatten(templates[name])
+            out_leaves = []
+            for li in range(len(leaves)):
+                k = (name, li)
+                if k not in bufs:
+                    raise CheckpointCorruptError(
+                        f"{pdir}: checkpoint has no data for "
+                        f"{name}/leaf{li} — tree structure mismatch with "
+                        "the saving run")
+                if filled[k] < bufs[k].size:
+                    raise CheckpointCorruptError(
+                        f"{pdir}: {name}/leaf{li} only "
+                        f"{filled[k]}/{bufs[k].size} elements present — "
+                        "a shard file is missing coverage")
+                out_leaves.append(bufs[k])
+            trees[name] = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        _monitor.counter(RESTORES_TOTAL, _HELP[RESTORES_TOTAL]).inc()
+        return trees, manifest
+    return None
+
+
+def prune_pod_checkpoints(runtime, directory: str,
+                          keep_last: int = 2) -> int:
+    """Delete all but the ``keep_last`` newest completed pod
+    checkpoints (process 0 only; returns how many were removed)."""
+    if runtime.process_index != 0:
+        return 0
+    import shutil
+    pruned = 0
+    for pdir in list_pod_checkpoints(directory)[max(1, keep_last):]:
+        try:
+            shutil.rmtree(pdir)
+            pruned += 1
+        except OSError:
+            pass
+    if pruned:
+        _monitor.counter(PRUNED_TOTAL, _HELP[PRUNED_TOTAL]).inc(pruned)
+    return pruned
+
+
 def resolve_fit_resilience(net, checkpoint, resume_from, epochs):
     """The shared ``fit()`` front half for both network classes:
     normalize ``checkpoint=``, perform the restore, and convert the

@@ -27,15 +27,15 @@ def initialize_from_env() -> bool:
     """``jax.distributed.initialize`` from standard env vars
     (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID — the PJRT
     distributed-runtime bootstrap).  Returns True when running multi-host;
-    False (no-op) when the env vars are absent."""
-    addr = os.environ.get("COORDINATOR_ADDRESS")
-    if not addr:
-        return False
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ["NUM_PROCESSES"]),
-        process_id=int(os.environ["PROCESS_ID"]))
-    return True
+    False (no-op) when the env vars are absent.
+
+    Delegates to :func:`parallel.mesh.ensure_distributed` — the ONE
+    bootstrap code path shared with :class:`parallel.mesh.MeshRuntime`
+    (documented precedence flags > env), so this module and the pod
+    runtime can never race ``jax.distributed.initialize`` with
+    conflicting topologies."""
+    from ..parallel.mesh import ensure_distributed
+    return ensure_distributed()
 
 
 def host_shard(paths: Sequence[str],

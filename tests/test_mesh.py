@@ -1,0 +1,354 @@
+"""MeshRuntime tests: the one-bootstrap contract (flags > env,
+idempotent, conflict-refusing), the bind-with-retry port helper, the
+global ``("data", "zero", "pipe")`` mesh with process-spanning staging,
+the updater-state residency telemetry, pod sharded checkpoints, and —
+slow-marked — real K=2 process pods launched through
+``parallel/main.py`` whose scores and param hashes must be bitwise
+identical to the single-process run."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.parallel import mesh as M
+from deeplearning4j_tpu.parallel.mesh import MeshRuntime
+from deeplearning4j_tpu.resilience import (CheckpointCorruptError,
+                                           list_pod_checkpoints,
+                                           pod_restore, pod_save,
+                                           prune_pod_checkpoints,
+                                           verify_pod_checkpoint)
+
+
+# ------------------------------------------------------------ bootstrap
+
+def test_resolve_topology_precedence_flags_over_env():
+    env = {M.ENV_COORDINATOR: "envhost:1111", M.ENV_NUM_PROCESSES: "4",
+           M.ENV_PROCESS_ID: "3"}
+    # env alone
+    t = M.resolve_topology(env=env)
+    assert t == {"coordinator": "envhost:1111", "num_processes": 4,
+                 "process_id": 3}
+    # flags beat env wholesale
+    t = M.resolve_topology("flag:2222", 2, 1, env=env)
+    assert t == {"coordinator": "flag:2222", "num_processes": 2,
+                 "process_id": 1}
+    # partial flags: each field independently falls back to env
+    t = M.resolve_topology(coordinator="flag:2222", env=env)
+    assert t == {"coordinator": "flag:2222", "num_processes": 4,
+                 "process_id": 3}
+    # no coordinator anywhere -> single-process (None)
+    assert M.resolve_topology(env={}) is None
+    assert M.resolve_topology(num_processes=8, env={}) is None
+
+
+def test_resolve_topology_validates():
+    with pytest.raises(ValueError):
+        M.resolve_topology("h:1", 0, 0, env={})
+    with pytest.raises(ValueError):
+        M.resolve_topology("h:1", 2, 2, env={})
+    with pytest.raises(ValueError):
+        M.resolve_topology("h:1", 2, -1, env={})
+
+
+@pytest.fixture
+def clean_bootstrap():
+    M._reset_bootstrap_for_tests()
+    yield
+    M._reset_bootstrap_for_tests()
+
+
+def test_ensure_distributed_idempotent_and_conflict(clean_bootstrap,
+                                                    monkeypatch):
+    for var in (M.ENV_COORDINATOR, M.ENV_NUM_PROCESSES, M.ENV_PROCESS_ID):
+        monkeypatch.delenv(var, raising=False)
+    assert M.initialized_topology() is None
+    # no coordinator -> single-process no-op, nothing recorded
+    assert M.ensure_distributed() is False
+    assert M.initialized_topology() is None
+    # NUM_PROCESSES=1 records the shape WITHOUT spinning a coordinator
+    assert M.ensure_distributed("127.0.0.1:39999", 1, 0) is False
+    assert M.initialized_topology() == {
+        "coordinator": "127.0.0.1:39999", "num_processes": 1,
+        "process_id": 0}
+    # same topology again: idempotent
+    assert M.ensure_distributed("127.0.0.1:39999", 1, 0) is False
+    # a DIFFERENT topology must be refused, not re-initialized
+    with pytest.raises(RuntimeError, match="conflicting"):
+        M.ensure_distributed("127.0.0.1:39999", 2, 0)
+    with pytest.raises(RuntimeError, match="conflicting"):
+        M.ensure_distributed("other:1234", 1, 0)
+
+
+def test_dcn_initialize_from_env_shares_bootstrap(clean_bootstrap,
+                                                  monkeypatch):
+    """scaleout/dcn.py and MeshRuntime use the SAME code path — after
+    dcn's env bootstrap, a conflicting MeshRuntime flag bootstrap is
+    refused instead of racing jax.distributed.initialize."""
+    from deeplearning4j_tpu.scaleout.dcn import initialize_from_env
+    monkeypatch.setenv(M.ENV_COORDINATOR, "127.0.0.1:39998")
+    monkeypatch.setenv(M.ENV_NUM_PROCESSES, "1")
+    monkeypatch.setenv(M.ENV_PROCESS_ID, "0")
+    assert initialize_from_env() is False       # n=1: recorded, no coord
+    assert M.initialized_topology()["coordinator"] == "127.0.0.1:39998"
+    with pytest.raises(RuntimeError, match="conflicting"):
+        M.ensure_distributed("127.0.0.1:12345", 2, 1)
+
+
+# --------------------------------------------------------- port helpers
+
+def test_is_port_clash_markers():
+    assert M.is_port_clash("... EADDRINUSE ...")
+    assert M.is_port_clash("bind: Address already in use")
+    assert not M.is_port_clash("worker exited cleanly")
+
+
+def test_retry_on_port_clash_retries_with_fresh_ports():
+    seen = []
+
+    def launch(port):
+        seen.append(port)
+        return (len(seen) >= 3, {"port": port})
+
+    out = M.retry_on_port_clash(launch, attempts=4)
+    assert out == {"port": seen[-1]}
+    assert len(seen) == 3
+    assert all(1 <= p <= 65535 for p in seen)
+
+
+def test_retry_on_port_clash_gives_up():
+    calls = []
+
+    def launch(port):
+        calls.append(port)
+        return (False, "EADDRINUSE")
+
+    with pytest.raises(RuntimeError, match="clashed"):
+        M.retry_on_port_clash(launch, attempts=3)
+    assert len(calls) == 3
+
+
+# ------------------------------------------------------- local runtime
+
+def test_mesh_runtime_shapes_and_topology():
+    rt = MeshRuntime.local(data=2, zero=2)
+    assert rt.mesh.axis_names == M.AXES == ("data", "zero", "pipe")
+    assert (rt.data_degree, rt.zero_degree, rt.pipe_degree) == (2, 2, 1)
+    assert rt.dp_degree == 4
+    assert rt.is_multiprocess is False
+    assert rt.topology() == {"data": 2, "zero": 2, "pipe": 1,
+                             "num_processes": 1}
+    assert "data=2" in rt.describe() and "zero=2" in rt.describe()
+
+
+def test_mesh_runtime_infers_data_degree():
+    import jax
+    n = len(jax.devices())
+    rt = MeshRuntime.local(data=None, zero=2)
+    assert rt.data_degree == n // 2
+    assert len(rt.devices) == rt.data_degree * 2
+
+
+def test_mesh_runtime_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        MeshRuntime.local(data=64, zero=2)      # 128 > 8 devices
+    with pytest.raises(ValueError):
+        MeshRuntime.local(data=1, zero=0)
+    with pytest.raises(ValueError):
+        MeshRuntime.local(data=None, zero=16)   # no room for data >= 1
+
+
+def test_put_and_to_host_roundtrip():
+    rt = MeshRuntime.local(data=2, zero=2)
+    host = np.arange(4 * 5, dtype=np.float32).reshape(4, 5)
+    arr = rt.put(host, P(("data", "zero")))
+    assert arr.sharding.spec == P(("data", "zero"))
+    np.testing.assert_array_equal(rt.to_host(arr), host)
+    # replicated staging + tree form
+    tree = rt.put_tree({"a": host, "b": host[0]}, P())
+    np.testing.assert_array_equal(rt.to_host(tree["b"]), host[0])
+
+
+def test_state_bytes_dedupe_and_gauge():
+    """Replicated copies across local devices count ONCE; the published
+    gauge is the per-process residency the zero axis shrinks."""
+    rt = MeshRuntime.local(data=2, zero=2)
+    host = np.zeros((8, 4), dtype=np.float32)       # 128 bytes nominal
+    replicated = {"m": rt.put(host, P())}
+    sharded = {"m": rt.put(host, P("zero"))}
+    assert rt.addressable_state_bytes(replicated) == host.nbytes
+    assert rt.addressable_state_bytes(sharded) == host.nbytes
+    n = rt.publish_state_bytes(sharded, axis="zero")
+    assert n == host.nbytes
+    g = monitor.gauge(M.STATE_BYTES_GAUGE)
+    assert g.value(axis="zero") == host.nbytes
+
+
+def test_measure_collectives_publishes_per_axis():
+    rt = MeshRuntime.local(data=2, zero=2)
+    out = rt.measure_collectives(size=256, repeats=1)
+    assert set(out) == {"data/all_reduce", "data/all_gather",
+                       "zero/all_reduce", "zero/all_gather"}
+    assert all(v > 0 for v in out.values())
+    # pipe has degree 1 -> not measured
+    assert not any(k.startswith("pipe/") for k in out)
+
+
+# ------------------------------------------------- pod checkpoints
+
+def _trees(rt):
+    rng = np.random.RandomState(3)
+    params = {"w": rng.randn(6, 4).astype(np.float32),
+              "b": rng.randn(4).astype(np.float32)}
+    ustate = {"m": rng.randn(rt.zero_degree, 8).astype(np.float32)}
+    staged = {"params": rt.put_tree(params, P()),
+              "ustate": rt.put_tree(ustate, P("zero"))}
+    return params, ustate, staged
+
+
+def test_pod_checkpoint_roundtrip(tmp_path):
+    rt = MeshRuntime.local(data=2, zero=2)
+    params, ustate, staged = _trees(rt)
+    d = str(tmp_path)
+    pod_save(rt, d, step=7, trees=staged, extra={"next_step": 8})
+    pdirs = list_pod_checkpoints(d)
+    assert len(pdirs) == 1 and pdirs[0].endswith("pod-0000000007")
+    verify_pod_checkpoint(pdirs[0], topology=rt.topology())
+
+    templates = {"params": {"w": np.zeros((6, 4), np.float32),
+                            "b": np.zeros(4, np.float32)},
+                 "ustate": {"m": np.zeros((2, 8), np.float32)}}
+    trees, manifest = pod_restore(rt, d, templates)
+    assert manifest["step"] == 7
+    assert manifest["extra"]["next_step"] == 8
+    assert manifest["topology"] == rt.topology()
+    np.testing.assert_array_equal(trees["params"]["w"], params["w"])
+    np.testing.assert_array_equal(trees["params"]["b"], params["b"])
+    np.testing.assert_array_equal(trees["ustate"]["m"], ustate["m"])
+
+
+def test_pod_checkpoint_refuses_wrong_topology(tmp_path):
+    rt = MeshRuntime.local(data=2, zero=2)
+    _, _, staged = _trees(rt)
+    d = str(tmp_path)
+    pod_save(rt, d, step=1, trees=staged, extra={})
+    pdir = list_pod_checkpoints(d)[0]
+    other = MeshRuntime.local(data=4, zero=1)
+    with pytest.raises(CheckpointCorruptError, match="topology"):
+        verify_pod_checkpoint(pdir, topology=other.topology())
+    # pod_restore validates the same stamp: auto-resume refuses to
+    # misassemble (cold start), a pinned step raises loudly
+    templates = {"params": {"w": np.zeros((6, 4), np.float32),
+                            "b": np.zeros(4, np.float32)},
+                 "ustate": {"m": np.zeros((2, 8), np.float32)}}
+    assert pod_restore(other, d, templates) is None
+    with pytest.raises(CheckpointCorruptError, match="topology"):
+        pod_restore(other, d, templates, step=1)
+
+
+def test_pod_checkpoint_missing_manifest_is_invisible(tmp_path):
+    """Manifest-last kill-safety: a directory without its manifest (a
+    save killed mid-write) is not listed and cold-starts the restore."""
+    import os
+    rt = MeshRuntime.local(data=2, zero=2)
+    _, _, staged = _trees(rt)
+    d = str(tmp_path)
+    pod_save(rt, d, step=1, trees=staged, extra={})
+    pod_save(rt, d, step=2, trees=staged, extra={})
+    pdirs = list_pod_checkpoints(d)
+    assert [os.path.basename(p) for p in pdirs] == ["pod-0000000002",
+                                                    "pod-0000000001"]
+    # kill the newest save's manifest -> it disappears from listing
+    os.remove(os.path.join(pdirs[0], "pod-manifest.json"))
+    assert [os.path.basename(p) for p in list_pod_checkpoints(d)] == [
+        "pod-0000000001"]
+    templates = {"params": {"w": np.zeros((6, 4), np.float32),
+                            "b": np.zeros(4, np.float32)},
+                 "ustate": {"m": np.zeros((2, 8), np.float32)}}
+    trees, manifest = pod_restore(rt, d, templates)
+    assert manifest["step"] == 1
+    # nothing at all -> cold start (None), not an error
+    assert pod_restore(rt, str(tmp_path / "empty"), templates) is None
+
+
+def test_pod_checkpoint_detects_shard_corruption(tmp_path):
+    import os
+    rt = MeshRuntime.local(data=2, zero=2)
+    _, _, staged = _trees(rt)
+    d = str(tmp_path)
+    pod_save(rt, d, step=3, trees=staged, extra={})
+    pdir = list_pod_checkpoints(d)[0]
+    shard = [f for f in os.listdir(pdir) if f.startswith("shard-")][0]
+    path = os.path.join(pdir, shard)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(CheckpointCorruptError):
+        verify_pod_checkpoint(pdir, topology=rt.topology())
+
+
+def test_prune_pod_checkpoints(tmp_path):
+    rt = MeshRuntime.local(data=2, zero=2)
+    _, _, staged = _trees(rt)
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        pod_save(rt, d, step=step, trees=staged, extra={})
+    prune_pod_checkpoints(rt, d, keep_last=2)
+    import os
+    assert [os.path.basename(p) for p in list_pod_checkpoints(d)] == [
+        "pod-0000000004", "pod-0000000003"]
+
+
+# ------------------------------------------- real K-process pods (slow)
+
+@pytest.mark.slow
+def test_pod_dp_two_processes_bitwise_matches_single(tmp_path):
+    """K=2 OS processes (1 device each) over the gloo CPU fabric must
+    produce bitwise-identical fp32 scores AND param sha to the K=1 run
+    over 2 virtual devices — same mesh shape, same program."""
+    from deeplearning4j_tpu.parallel.main import run_pod
+    multi = run_pod(k=2, data=2, mode="dp", steps=4, batch=16)
+    single = run_pod(k=1, data=2, mode="dp", steps=4, batch=16)
+    assert multi["returncodes"] == [0, 0]
+    assert single["returncodes"] == [0]
+    assert multi["scores"] == single["scores"]
+    assert multi["param_sha"] == single["param_sha"]
+    # every process in the pod reports the same final params
+    shas = {r["param_sha"] for r in multi["reports"]}
+    assert len(shas) == 1
+
+
+@pytest.mark.slow
+def test_pod_zero_two_processes_parity_and_bytes_drop(tmp_path):
+    """DP x ZeRO across 2 real processes: bitwise parity with the
+    single-process run, AND the per-process updater-state residency
+    must drop vs the unsharded dp pod (the ZeRO memory win the
+    mesh_updater_state_bytes gauge reports)."""
+    from deeplearning4j_tpu.parallel.main import run_pod
+    zero2 = run_pod(k=2, data=1, zero=2, mode="zero", steps=4, batch=16)
+    single = run_pod(k=1, data=1, zero=2, mode="zero", steps=4, batch=16)
+    dp2 = run_pod(k=2, data=2, mode="dp", steps=4, batch=16)
+    assert zero2["returncodes"] == [0, 0]
+    assert zero2["scores"] == single["scores"]
+    assert zero2["param_sha"] == single["param_sha"]
+    assert zero2["updater_state_bytes"] <= 0.6 * dp2["updater_state_bytes"]
+
+
+@pytest.mark.slow
+def test_pod_kill_and_resume_matches_uninterrupted(tmp_path):
+    """SIGKILL process 1 at step entry mid-run, relaunch the whole pod
+    with --resume auto: the resumed scores continue the curve and the
+    final params match the uninterrupted run bitwise."""
+    from deeplearning4j_tpu.parallel.main import run_pod
+    d = str(tmp_path / "ckpt")
+    interrupted = run_pod(k=2, data=2, mode="dp", steps=6, batch=16,
+                          checkpoint_dir=d, checkpoint_every=2,
+                          die_at=(1, 4), relaunch=True)
+    clean = run_pod(k=2, data=2, mode="dp", steps=6, batch=16)
+    assert any(rc != 0 for rc in interrupted["returncodes"])
+    resumed = interrupted["resumed"]
+    assert resumed["returncodes"] == [0, 0]
+    assert resumed["reports"][0]["start_step"] == 4
+    # restored history + resumed steps == the uninterrupted curve, bitwise
+    assert resumed["scores"] == clean["scores"]
+    assert resumed["param_sha"] == clean["param_sha"]

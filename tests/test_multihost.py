@@ -8,7 +8,6 @@ cluster test)."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -61,12 +60,6 @@ print("WORKER_DONE", cfg["process_id"], flush=True)
 """
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def _make_export(tmp_path, n_batches=8, batch=16, seed=0):
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.scaleout.data import batch_and_export
@@ -84,40 +77,50 @@ def _make_export(tmp_path, n_batches=8, batch=16, seed=0):
 
 @pytest.mark.slow
 def test_two_process_cluster_trains_and_agrees(tmp_path):
+    from deeplearning4j_tpu.parallel.mesh import (is_port_clash,
+                                                  retry_on_port_clash)
     export_dir = _make_export(tmp_path)
     out_dir = str(tmp_path / "out")
     os.makedirs(out_dir)
-    port = _free_port()
-    procs = []
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     inherited = os.environ.get("PYTHONPATH", "")
     env["PYTHONPATH"] = (repo_root + os.pathsep + inherited
                          if inherited else repo_root)
-    outs = []
-    try:
-        for pid in range(2):
-            cfg = json.dumps({
-                "coordinator": f"127.0.0.1:{port}",
-                "num_processes": 2,
-                "process_id": pid,
-                "export_dir": export_dir,
-                "out_dir": out_dir,
-            })
-            procs.append(subprocess.Popen(
-                [sys.executable, "-c", _WORKER, cfg], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
-        for p in procs:
-            out, _ = p.communicate(timeout=300)
-            outs.append(out)
-    finally:
-        # a worker hung in a collective must not outlive the test
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+
+    def launch(port):
+        procs = []
+        outs = []
+        try:
+            for pid in range(2):
+                cfg = json.dumps({
+                    "coordinator": f"127.0.0.1:{port}",
+                    "num_processes": 2,
+                    "process_id": pid,
+                    "export_dir": export_dir,
+                    "out_dir": out_dir,
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _WORKER, cfg], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True))
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            # a worker hung in a collective must not outlive the test
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        clashed = any(p.returncode != 0 and is_port_clash(out)
+                      for p, out in zip(procs, outs))
+        return (not clashed, (procs, outs))
+
+    # bind-with-retry: a stolen coordinator port re-launches on a fresh
+    # one instead of flaking the test (shared helper with the pod launcher)
+    procs, outs = retry_on_port_clash(launch)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER_DONE {pid}" in out

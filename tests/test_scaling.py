@@ -1,0 +1,53 @@
+"""parallel/scaling.py coverage: the collective-overhead report's shape
+(the one-chip scaling substitute bench.py publishes) and the workers=1
+degenerate throughput path."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.scaling import (collective_overhead_report,
+                                                 measure_throughput,
+                                                 scaling_report)
+
+
+def _factory():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("sgd").learning_rate(0.1)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3)).build())
+    return MultiLayerNetwork(conf)
+
+
+def test_collective_overhead_report_shape():
+    rep = collective_overhead_report(_factory, batch_size=16,
+                                     feature_shape=(4,), n_classes=3,
+                                     steps=2, trials=1, pipeline=2)
+    assert set(rep) == {"plain_step_ms", "shard_map_step_ms",
+                        "overhead_ms", "overhead_ratio", "batch", "device"}
+    assert rep["plain_step_ms"] > 0
+    assert rep["shard_map_step_ms"] > 0
+    assert rep["batch"] == 16
+    # the ratio is the two step times' quotient (rounding tolerance)
+    assert rep["overhead_ratio"] == pytest.approx(
+        rep["shard_map_step_ms"] / rep["plain_step_ms"], rel=1e-2)
+    assert rep["overhead_ms"] == pytest.approx(
+        rep["shard_map_step_ms"] - rep["plain_step_ms"], abs=1e-2)
+
+
+def test_measure_throughput_workers1_degenerate():
+    tput = measure_throughput(_factory, workers=1, batch_size=8,
+                              n_rounds=2, feature_shape=(4,), n_classes=3,
+                              warmup_rounds=1)
+    assert np.isfinite(tput) and tput > 0
+
+
+def test_scaling_report_workers1_efficiency_is_one():
+    rep = scaling_report(_factory, [1], batch_size=8, n_rounds=2,
+                         feature_shape=(4,), n_classes=3, warmup_rounds=1)
+    assert set(rep) == {1}
+    assert rep[1]["workers"] == 1
+    assert rep[1]["efficiency"] == pytest.approx(1.0)
+    assert rep[1]["samples_per_sec"] > 0
