@@ -25,6 +25,7 @@ chip throughput), and (c) reports the best of TRIALS timed regions.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -1456,6 +1457,252 @@ def _serving_compile_count() -> float:
     return total
 
 
+def bench_deploy(smoke: bool = False) -> dict:
+    """Zero-downtime deployment acceptance (``deploy/``): a live
+    ``fit()`` publishes weight versions into a
+    :class:`~deeplearning4j_tpu.deploy.VersionedWeightStore` while the
+    same model serves HTTP traffic; a sidecar
+    :class:`~deeplearning4j_tpu.deploy.RolloutController` canaries and
+    promotes each version.  The stdout line asserts the four
+    acceptance properties:
+
+    - >= 2 automatic promotions (``push -> probe -> promote``) land
+      during/after training, and served accuracy strictly improves
+      from the untrained baseline;
+    - the constant client load observes ZERO 5xx across every swap;
+    - ``serving_bucket_compiles_total`` never moves after warmup
+      (weights are call operands — swap is pure data motion);
+    - a seeded bad update (garbage weights) canaries, fails the gates,
+      auto-rolls-back leaving a ``rollout_rollback`` flight bundle,
+      and a corrupted snapshot is refused over HTTP with a 4xx and no
+      engine change.
+    """
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.deploy import (DeploymentListener,
+                                           RolloutController,
+                                           VersionedWeightStore)
+    from deeplearning4j_tpu.nn.conf import inputs as _inputs
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import InferenceEngine, ModelRegistry
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    n_in, n_out, hidden = 8, 3, 16
+    n_train = 192 if smoke else 512
+    epochs = 2 if smoke else 4
+    tmp = tempfile.mkdtemp(prefix="dl4j-deploy-")
+    os.environ[("DL4J_TPU_FLIGHT_DIR")] = os.path.join(tmp, "flight")
+    os.environ["DL4J_TPU_FLIGHT_MIN_INTERVAL_S"] = "0"
+
+    # seeded 3-class gaussian blobs: separable enough that even a short
+    # fit() beats the untrained baseline by a wide margin
+    rng = np.random.RandomState(7)
+    centers = rng.randn(n_out, n_in) * 3.0
+    cls = rng.randint(0, n_out, size=n_train)
+    X = (centers[cls] + rng.randn(n_train, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[cls]
+    Xe, ye = X[:64], y[:64]
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd").learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_out=hidden))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(_inputs.feed_forward(n_in))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    registry = ModelRegistry()
+    registry.register(
+        "deploy",
+        InferenceEngine(net, max_batch_size=16, max_latency_ms=1.0,
+                        queue_capacity=256, name="deploy"),
+        warmup_shape=(n_in,))
+    store = VersionedWeightStore(os.path.join(tmp, "store"))
+    ctl = RolloutController(registry, "deploy", store,
+                            canary_fraction=0.3,
+                            eval_features=Xe, eval_labels=ye,
+                            min_probe_rounds=2)
+    ui = UIServer(port=0).attach_registry(registry).attach_deployment(ctl)
+    ui.start()
+    base = f"http://127.0.0.1:{ui.port}"
+
+    def served_accuracy() -> float:
+        out = np.concatenate(
+            [np.asarray(registry.predict("deploy", Xe[i:i + 16]))
+             for i in range(0, len(Xe), 16)])
+        return float(np.mean(np.argmax(out, -1) == np.argmax(ye, -1)))
+
+    acc_before = served_accuracy()
+    compiles0 = _serving_compile_count()
+
+    # -- constant client load over HTTP; every swap happens under it ----
+    codes: dict = {}
+    stop = threading.Event()
+    stop_roller = threading.Event()
+
+    def load_client():
+        body = json.dumps({"model": "deploy",
+                           "features": Xe[:4].tolist()}).encode()
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    base + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    codes[r.status] = codes.get(r.status, 0) + 1
+            except urllib.error.HTTPError as e:
+                codes[e.code] = codes.get(e.code, 0) + 1
+            except Exception:
+                codes["io"] = codes.get("io", 0) + 1
+            time.sleep(0.005)
+
+    # -- sidecar rollout loop: promotes whatever fit() publishes --------
+    actions: list = []
+
+    def rollout_loop():
+        while not stop_roller.is_set():
+            try:
+                act = ctl.step()
+            except Exception as e:        # corrupt push etc. must not kill it
+                act = f"error:{type(e).__name__}"
+            if act != "noop":
+                actions.append(act)
+            time.sleep(0.01)
+
+    loader = threading.Thread(target=load_client, daemon=True)
+    roller = threading.Thread(target=rollout_loop, daemon=True)
+    loader.start()
+    roller.start()
+
+    def drain(timeout_s: float) -> None:
+        """Wait for the sidecar to consume the store head (or
+        quarantine it) and return to idle."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            head = ctl.store.latest()
+            if (ctl.state == "idle"
+                    and head is not None
+                    and (registry.get("deploy").active_version >= head
+                         or head in ctl.quarantined)):
+                return
+            time.sleep(0.05)
+
+    # two fit segments, each publishing versions the sidecar promotes —
+    # the >= 2 promotions land while the load thread hammers /predict
+    listener = DeploymentListener(store, every_n_iterations=0,
+                                  publish_on_epoch_end=True)
+    net.set_listeners(listener)
+    seg_timeout = 30 if smoke else 60
+    net.fit(X, y, epochs=max(1, epochs // 2))
+    drain(seg_timeout)
+    net.fit(X, y, epochs=max(1, epochs - epochs // 2))
+    drain(seg_timeout)
+    acc_after = served_accuracy()
+    promotions = sum(1 for h in ctl.history if h["action"] == "promote")
+
+    # -- seeded bad update: garbage weights must canary then roll back --
+    n_params = net.get_flat_params().size
+    active_before_bad = registry.get("deploy").active_version
+    store.publish(rng.randn(n_params).astype(np.float32) * 100.0,
+                  source="bad_update")
+    deadline = time.time() + (20 if smoke else 40)
+    rollbacks = 0
+    while time.time() < deadline:
+        rollbacks = sum(1 for h in ctl.history
+                        if h["action"] == "rollback")
+        if rollbacks >= 1 and ctl.state == "idle":
+            break
+        time.sleep(0.05)
+    active_after_bad = registry.get("deploy").active_version
+
+    # -- corrupted snapshot over HTTP: 4xx, no swap ---------------------
+    # stop the sidecar first: the corruption below must land before
+    # anything races to push the fresh version
+    stop_roller.set()
+    roller.join(timeout=5)
+    vbad = store.publish(net.get_flat_params(), source="corrupt_me")
+    _corrupt_store_entry(store, vbad)
+    corrupt_code = None
+    try:
+        req = urllib.request.Request(
+            base + "/deploy/deploy",
+            data=json.dumps({"action": "push", "version": vbad}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            corrupt_code = r.status
+    except urllib.error.HTTPError as e:
+        corrupt_code = e.code
+    active_after_corrupt = registry.get("deploy").active_version
+
+    stop.set()
+    loader.join(timeout=5)
+    ui.stop()
+    compiles = _serving_compile_count() - compiles0
+
+    n5xx = sum(v for k, v in codes.items()
+               if isinstance(k, int) and 500 <= k < 600)
+    ok = bool(promotions >= 2
+              and acc_after > acc_before
+              and n5xx == 0
+              and compiles == 0
+              and rollbacks >= 1
+              and ctl.last_bundle
+              and active_after_bad == active_before_bad
+              and active_after_corrupt == active_before_bad
+              and corrupt_code is not None and 400 <= corrupt_code < 500)
+    return {"metric": "deploy_hot_swap_acceptance", "value": int(ok),
+            "unit": "pass", "vs_baseline": None, "smoke": smoke,
+            "pass": ok,
+            "promotions": promotions,
+            "published_versions": listener.published,
+            "served_acc_before": round(acc_before, 4),
+            "served_acc_after": round(acc_after, 4),
+            "acc_improved": bool(acc_after > acc_before),
+            "http_codes": {str(k): v for k, v in sorted(
+                codes.items(), key=str)},
+            "http_5xx": n5xx,
+            "recompiles_after_warmup": compiles,
+            "rollbacks": rollbacks,
+            "rollback_bundle": ctl.last_bundle,
+            "bad_update_rolled_back": bool(
+                rollbacks >= 1
+                and active_after_bad == active_before_bad),
+            "corrupt_push_status": corrupt_code,
+            "corrupt_rejected": bool(
+                corrupt_code is not None and 400 <= corrupt_code < 500),
+            "active_version": registry.get("deploy").active_version,
+            "rollout_actions": actions[-20:]}
+
+
+def _corrupt_store_entry(store, version: int) -> None:
+    """Flip bytes inside a snapshot's ``flat.bin`` while keeping the
+    (now stale) manifest — a guaranteed SHA-256 mismatch on load.
+    Byte-flipping the zip at a random offset is NOT enough: zip readers
+    go through the central directory and ignore damaged local headers."""
+    import io
+    import zipfile
+    path = os.path.join(store.directory,
+                        "weights-v%010d.zip" % int(version))
+    with zipfile.ZipFile(path) as zf:
+        entries = {n: zf.read(n) for n in zf.namelist()}
+    flat = bytearray(entries["flat.bin"])
+    flat[len(flat) // 2] ^= 0xFF
+    entries["flat.bin"] = bytes(flat)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for n, b in entries.items():
+            zf.writestr(n, b)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
 def bench_scaling() -> dict:
     """ParallelWrapper scaling efficiency 1→8 on a virtual CPU mesh, in a
     subprocess (the TPU session only has one real chip; the CPU mesh is the
@@ -1675,6 +1922,17 @@ def main() -> None:
         # CI scaleout-async job asserts parity_ok, wire_ok (>=3x), and
         # staleness_gauge_on_metrics.
         print(json.dumps(bench_scaleout(smoke="--smoke" in sys.argv)),
+              flush=True)
+        return
+    if "--deploy" in sys.argv:
+        # Deployment proof: a live fit() publishes versions while the
+        # model serves HTTP traffic; the rollout sidecar canaries and
+        # promotes them (>= 2 promotions, accuracy improves, zero 5xx,
+        # zero recompiles), a seeded bad update auto-rolls-back with a
+        # flight bundle, and a corrupted snapshot answers 4xx with no
+        # swap.  One stdout JSON line; the CI deploy-smoke job asserts
+        # value == 1.
+        print(json.dumps(bench_deploy(smoke="--smoke" in sys.argv)),
               flush=True)
         return
     if "--smoke" in sys.argv:

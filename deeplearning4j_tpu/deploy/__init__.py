@@ -1,0 +1,30 @@
+"""Zero-downtime continuous deployment: the learner->server weight
+hot-swap control plane (docs/DEPLOY.md).
+
+Closes the loop between the repo's two halves: a learner that trains
+(``fit()`` / the param server) and a registry-backed multi-model
+server (``serving/``).  Because bucket executables take weights as
+call operands (the PR-8 page-out invariant), a server swaps a resident
+model's weights **without recompiling** — so deployment becomes pure
+data motion:
+
+- :class:`~deeplearning4j_tpu.deploy.store.VersionedWeightStore`:
+  monotonically versioned, SHA-manifested weight snapshots published
+  from a live ``fit()`` (:class:`~deeplearning4j_tpu.deploy.store.
+  DeploymentListener`) or a param server (:class:`~deeplearning4j_tpu.
+  deploy.store.ParamServerPoller`);
+- :class:`~deeplearning4j_tpu.deploy.rollout.RolloutController`: pages
+  version N+1 in alongside N, canaries a traffic fraction, gates on
+  per-version p99 + accuracy/agreement, then promotes (atomic pointer
+  flip) or auto-rolls-back with a ``rollout_rollback`` flight-recorder
+  bundle.
+"""
+
+from .rollout import CANARY, IDLE, RolloutController, RolloutError
+from .store import (DeploymentListener, ParamServerPoller,
+                    VersionedWeightStore, WeightSnapshot,
+                    WeightStoreCorruptError, tree_from_flat)
+
+__all__ = ["CANARY", "DeploymentListener", "IDLE", "ParamServerPoller",
+           "RolloutController", "RolloutError", "VersionedWeightStore",
+           "WeightSnapshot", "WeightStoreCorruptError", "tree_from_flat"]

@@ -1171,7 +1171,8 @@ class ComputationGraph:
             outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs
 
-    def rnn_stateless_step(self, carries, *features):
+    def rnn_stateless_step(self, carries, *features, params=None,
+                           net_state=None):
         """Explicit-carry streaming step (re-entrant twin of
         :meth:`rnn_time_step`): advance the given carry dict by the input
         timesteps and return ``(outs, new_carries)`` without touching the
@@ -1180,7 +1181,11 @@ class ComputationGraph:
         ``carries=None`` starts from zero state; inputs must be 3-D
         ``(batch, time, n_in)``; ``outs`` is always a list (one per
         graph output) and each call is ONE dispatch of the jitted
-        ``cg.advance`` program."""
+        ``cg.advance`` program.  ``params``/``net_state`` override the
+        weight operands (same shapes/dtypes → jit cache hit, no
+        recompile) so a serving session can stay pinned to the weight
+        version its carries came from across a hot-swap
+        (docs/DEPLOY.md)."""
         self.init()
         self._require_carry_support("rnn_stateless_step")
         xs = tuple(jnp.asarray(f) for f in features)
@@ -1191,8 +1196,10 @@ class ComputationGraph:
                     f"inputs, got shape {x.shape}")
         if carries is None:
             carries = self._init_carries(int(xs[0].shape[0]))
-        return self._advance_fn(self.params, self.net_state, carries,
-                                xs, None)
+        return self._advance_fn(
+            self.params if params is None else params,
+            self.net_state if net_state is None else net_state,
+            carries, xs, None)
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""

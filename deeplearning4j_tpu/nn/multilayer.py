@@ -1202,7 +1202,8 @@ class MultiLayerNetwork:
         out = np.asarray(out)
         return out[:, -1] if squeeze else out
 
-    def rnn_stateless_step(self, carries, features):
+    def rnn_stateless_step(self, carries, features, params=None,
+                           net_state=None):
         """Explicit-carry streaming step (the re-entrant twin of
         :meth:`rnn_time_step`): advance the given carry pytree by the
         input timesteps and return ``(out, new_carries)`` WITHOUT
@@ -1215,6 +1216,12 @@ class MultiLayerNetwork:
 
         3-D ``features`` only (``(batch, time, n_in)``); the session
         layer owns the 2-D squeeze convention.
+
+        ``params``/``net_state`` override the weight operands (same
+        shapes/dtypes, so the jitted step is a cache hit, never a
+        recompile) — what lets a serving session stay pinned to the
+        weight version its carries came from across a hot-swap
+        (docs/DEPLOY.md).
         """
         self.init()
         self._require_carry_support("rnn_stateless_step")
@@ -1225,7 +1232,10 @@ class MultiLayerNetwork:
                 f"got shape {x.shape}")
         if carries is None:
             carries = self._init_carries(int(x.shape[0]))
-        return self._rnn_step_fn(self.params, self.net_state, carries, x)
+        return self._rnn_step_fn(
+            self.params if params is None else params,
+            self.net_state if net_state is None else net_state,
+            carries, x)
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""

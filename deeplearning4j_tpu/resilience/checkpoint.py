@@ -141,6 +141,29 @@ def list_checkpoints(directory: str) -> List[str]:
             for _, n in sorted(pairs, reverse=True)]
 
 
+def checkpoint_stamp(path: str) -> Optional[Tuple[int, float]]:
+    """The ``(iteration, wall_time)`` stamped INSIDE a checkpoint (its
+    manifest plus ``resume.json``), or None when unreadable.  This is
+    the ordering authority for :meth:`CheckpointManager.latest`: a
+    file's NAME is writable by anyone (copies, renames, clock-skewed
+    retention moves), but the stamp was written atomically with the
+    payload it describes."""
+    try:
+        with zipfile.ZipFile(path, "r") as zf:
+            manifest = json.loads(zf.read(MANIFEST_JSON))
+            it = int(manifest["iteration"])
+            wall = 0.0
+            if RESUME_JSON in zf.namelist():
+                try:
+                    wall = float(json.loads(
+                        zf.read(RESUME_JSON)).get("wall_time") or 0.0)
+                except (ValueError, TypeError):
+                    wall = 0.0
+            return (it, wall)
+    except Exception:
+        return None
+
+
 def verify_checkpoint(path: str) -> Dict[str, Any]:
     """Verify ``path`` against its own manifest (entry presence, exact
     sizes, SHA-256) and return the manifest.  Raises
@@ -572,8 +595,26 @@ class CheckpointManager:
     def latest(self, validate: bool = True) -> Optional[str]:
         """Newest checkpoint that passes verification (corrupt ones are
         skipped with a counter — a torn last write must not block
-        recovery from the one before it)."""
-        for path in list_checkpoints(self.directory):
+        recovery from the one before it).
+
+        "Newest" is decided by the monotonic ``(iteration, wall_time)``
+        stamp inside each checkpoint (:func:`checkpoint_stamp`), NOT by
+        filename: a snapshot copied/renamed to a higher-numbered name
+        (clock skew, retention tooling, manual restores) must not
+        shadow genuinely newer training state — the weight store's
+        polling reader depends on this ordering."""
+        stamped, stampless = [], []
+        for i, path in enumerate(list_checkpoints(self.directory)):
+            stamp = checkpoint_stamp(path)
+            if stamp is not None:
+                stamped.append((stamp, path))
+            else:
+                stampless.append(path)   # keeps filename (newest-first)
+        stamped.sort(key=lambda t: t[0], reverse=True)
+        # any stamped candidate outranks every stampless one; stampless
+        # files (pre-stamp era or unreadable manifests) keep the old
+        # filename ordering as a last resort
+        for path in [p for _, p in stamped] + stampless:
             if not validate:
                 return path
             try:

@@ -47,6 +47,17 @@ Serving v2 adds the multi-tenant machinery (docs/SERVING.md):
   traffic pays ONE single-timestep dispatch per request instead of
   full-sequence recompute.
 
+Deployment (docs/DEPLOY.md) builds on the same weights-are-operands
+fact the pager exploits: the engine holds **N versioned weight trees**
+against ONE set of bucket executables.  ``stage_weights`` registers
+version N+1 alongside N, ``set_canary`` routes a deterministic
+fraction of requests to it (the batcher never mixes versions in one
+batch), ``promote`` is an atomic pointer flip and ``rollback`` drops
+the canary — none of which compiles anything, which
+``serving_bucket_compiles_total`` proves.  Sessions opened before a
+swap stay pinned to the version they started on
+(``serving.sessions.SessionCache``).
+
 The ``NativeModelRunner`` PJRT path is available as
 ``backend="native"``: same bucketer (the ladder bounds the runner's
 per-shape executable cache), execution through the C++ PJRT client.
@@ -105,13 +116,15 @@ class SloShed(ServingError):
 
 
 class _Request:
-    __slots__ = ("arrays", "n_rows", "sig", "t_enqueue", "t_wall",
-                 "t_dequeue", "ctx", "trace_id", "span_id", "future")
+    __slots__ = ("arrays", "n_rows", "sig", "version", "t_enqueue",
+                 "t_wall", "t_dequeue", "ctx", "trace_id", "span_id",
+                 "future")
 
-    def __init__(self, arrays, n_rows, sig):
+    def __init__(self, arrays, n_rows, sig, version):
         self.arrays = arrays
         self.n_rows = n_rows
         self.sig = sig
+        self.version = version
         self.t_enqueue = time.perf_counter()
         self.t_wall = time.time()
         self.t_dequeue = self.t_enqueue
@@ -127,12 +140,13 @@ class _Request:
 
 
 class _BatchJob:
-    __slots__ = ("requests", "sig", "rows")
+    __slots__ = ("requests", "sig", "rows", "version")
 
-    def __init__(self, requests, sig, rows):
+    def __init__(self, requests, sig, rows, version):
         self.requests = requests
         self.sig = sig
         self.rows = rows
+        self.version = version
 
 
 class InferenceEngine:
@@ -216,7 +230,20 @@ class InferenceEngine:
         self._queue: "queue.Queue" = queue.Queue(maxsize=int(queue_capacity))
         self._dispatch_q: "queue.Queue" = queue.Queue(maxsize=2 * n_workers)
         self._compiled: dict = {}        # (worker_idx, bucket_key) -> fn
-        self._placed: list = [None] * n_workers
+        # Versioned weights: version -> host (params, net_state).  The
+        # sentinel tree ``None`` means "the model's own live weights"
+        # (version 0 at construction); staged versions hold explicit
+        # trees.  Executables are version-agnostic (weights are call
+        # operands), so _placed caches device placements per
+        # (worker, version) against ONE compiled set.
+        self._weights: dict = {0: None}
+        self._active_version = 0
+        self._canary_version: Optional[int] = None
+        self._canary_fraction = 0.0
+        self._max_version_seen = 0
+        self._session_pins: dict = {}    # retired version -> host tree
+        self._route_counter = itertools.count()
+        self._placed: dict = {}          # (worker_idx, version) -> placed
         self._placed_lock = threading.Lock()
         self._compile_lock = threading.Lock()
         self._running = False
@@ -250,11 +277,19 @@ class InferenceEngine:
             self._queue.qsize(), engine=self._name)
 
     def _observe_latency(self, latency_ms: float,
-                         trace_hex: Optional[str] = None) -> None:
+                         trace_hex: Optional[str] = None,
+                         version: Optional[int] = None) -> None:
         _monitor.histogram(
             "serving_request_latency_ms",
             "end-to-end request latency (enqueue -> result), per model"
         ).observe(latency_ms, exemplar=trace_hex, model=self._name)
+        if version is not None:
+            # separate series so the rollout controller can window p99
+            # per weight version without perturbing the SLO signal
+            _monitor.histogram(
+                "serving_version_latency_ms",
+                "request latency per served weight version").observe(
+                latency_ms, model=self._name, version=str(version))
         if self._admission is not None:
             self._admission.observe(latency_ms)
         self._done_times.append(time.monotonic())
@@ -349,16 +384,21 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- submit
     def predict(self, features, timeout: Optional[float] = None,
-                block: bool = True):
+                block: bool = True, version: Optional[int] = None):
         """Blocking inference: enqueue, coalesce, return this request's
         rows (thread-safe; the engine batches concurrent callers).
         ``block=False`` rejects with ``QueueFull`` instead of waiting
         for queue space — the HTTP front end's policy, where the
-        bounded queue IS the buffer and saturation must 429."""
-        return self.predict_async(features, block=block).result(timeout)
+        bounded queue IS the buffer and saturation must 429.
+        ``version=`` pins the request to a specific staged weight
+        version (the rollout controller's probe path); the default
+        routes active/canary per the configured canary fraction."""
+        return self.predict_async(features, block=block,
+                                  version=version).result(timeout)
 
     def predict_async(self, features, block: bool = True,
-                      timeout: Optional[float] = None) -> Future:
+                      timeout: Optional[float] = None,
+                      version: Optional[int] = None) -> Future:
         """Enqueue and return a ``Future``.  With ``block=False`` (or a
         ``timeout``) a full queue raises ``QueueFull`` instead of
         blocking — the explicit backpressure signal.  With an SLO
@@ -369,7 +409,8 @@ class InferenceEngine:
         self._admit_or_shed()
         arrays = self._canonicalize(features)
         sig = self._signature(arrays)
-        req = _Request(arrays, int(arrays[0].shape[0]), sig)
+        req = _Request(arrays, int(arrays[0].shape[0]), sig,
+                       self._route_version(version))
         try:
             self._queue.put(req, block=block, timeout=timeout)
         except queue.Full:
@@ -400,7 +441,10 @@ class InferenceEngine:
             if self._sessions is None:
                 from .sessions import SessionCache
                 self._sessions = SessionCache(
-                    self._model, name=self._name, **self._session_opts)
+                    self._model, name=self._name,
+                    version_fn=lambda: self._active_version,
+                    weights_fn=self._weights_for_version,
+                    **self._session_opts)
             return self._sessions
 
     def predict_session(self, session_id: str, features):
@@ -461,49 +505,259 @@ class InferenceEngine:
     # ------------------------------------------------------------- paging
     def model_bytes(self) -> int:
         """Device bytes ONE worker's resident copy of this model costs
-        (params + state; the uint8 tree when ``quantize="int8"``) — the
-        registry pager's accounting unit."""
-        return self._model_bytes
+        (params + state; the uint8 tree when ``quantize="int8"``),
+        times the number of live weight versions (a staged canary
+        doubles the footprint until promote/rollback drops one tree) —
+        the registry pager's accounting unit."""
+        return self._model_bytes * max(1, len(self._weights))
 
     def resident_bytes(self) -> int:
-        """Currently-placed device bytes across workers (0 when paged
-        out)."""
+        """Currently-placed device bytes across workers and versions
+        (0 when paged out)."""
         with self._placed_lock:
             if self._backend == "native":
                 return (self._runner.resident_bytes()
                         if self._runner is not None else 0)
-            return self._model_bytes * sum(
-                1 for p in self._placed if p is not None)
+            return self._model_bytes * len(self._placed)
 
     def is_resident(self) -> bool:
         return self.resident_bytes() > 0
 
     def ensure_resident(self) -> int:
         """Page this model's weights onto every worker device (no-op
-        when already there).  Returns resident bytes."""
+        when already there) — every live version, so a staged canary
+        survives a page-out/page-in cycle.  Returns resident bytes."""
         if self._backend == "native":
             self._runner.ensure_device_buffers()
             return self.resident_bytes()
         for widx in range(len(self._devices)):
-            self._placed_params(widx)
+            for v in list(self._weights):
+                self._placed_params(widx, v)
         return self.resident_bytes()
 
     def release_device_buffers(self) -> int:
         """Drop every worker's placed weight buffers (the pager's evict
-        primitive).  Compiled bucket executables survive — they take
-        the weights as call operands, so the next ``ensure_resident``
-        (or lazy ``_placed_params``) page-in reuses them without any
-        recompilation.  Returns bytes released."""
+        primitive) — all versions.  Compiled bucket executables survive —
+        they take the weights as call operands, so the next
+        ``ensure_resident`` (or lazy ``_placed_params``) page-in reuses
+        them without any recompilation; a paged-out standby/canary
+        version re-places itself on the next request routed to it.
+        Returns bytes released."""
         with self._placed_lock:
             if self._backend == "native":
                 return (self._runner.free_device_buffers()
                         if self._runner is not None else 0)
-            freed = self._model_bytes * sum(
-                1 for p in self._placed if p is not None)
+            freed = self._model_bytes * len(self._placed)
             # in-flight dispatches hold their own references; dropping
             # ours lets the device free the buffers once they finish
-            self._placed = [None] * len(self._placed)
+            self._placed = {}
             return freed
+
+    # ---------------------------------------------------------- deployment
+    @property
+    def active_version(self) -> int:
+        return self._active_version
+
+    @property
+    def canary_version(self) -> Optional[int]:
+        return self._canary_version
+
+    @property
+    def canary_fraction(self) -> float:
+        return self._canary_fraction
+
+    def versions(self) -> List[int]:
+        """Servable weight versions currently staged (active + canary +
+        staged), ascending."""
+        return sorted(self._weights)
+
+    def _require_swappable(self) -> None:
+        if self._backend == "native":
+            raise ServingError(
+                "weight hot-swap requires backend='aot' (the native "
+                "runner uploads the model's own buffers)")
+        if self._quantize:
+            raise ServingError(
+                "weight hot-swap requires quantize=None: int8 engines "
+                "bake per-tensor decode specs into the executable, so "
+                "new weights would need a recompile — deploy the f32 "
+                "engine and re-quantize offline instead")
+
+    def stage_weights(self, params, net_state=None,
+                      version: Optional[int] = None) -> int:
+        """Register a new host weight tree as a servable version
+        ALONGSIDE the active one (no routing change, no compile, no
+        placement until traffic or ``ensure_resident`` touches it).
+        ``version=None`` allocates the next monotonic version.
+        Returns the version."""
+        self._require_swappable()
+        with self._placed_lock:
+            if version is None:
+                version = self._max_version_seen + 1
+            version = int(version)
+            if version <= self._max_version_seen:
+                raise ValueError(
+                    f"version {version} is not newer than "
+                    f"{self._max_version_seen}; versions are monotonic")
+            state = (net_state if net_state is not None
+                     else self._model.net_state)
+            self._weights[version] = (params, state)
+            self._max_version_seen = version
+        return version
+
+    def set_canary(self, version: int, fraction: float = 0.1) -> None:
+        """Route ``fraction`` of un-pinned predict traffic to
+        ``version`` (deterministic counter-based split, so tests and
+        canary windows are exact, not stochastic)."""
+        fraction = min(1.0, max(0.0, float(fraction)))
+        with self._placed_lock:
+            if version not in self._weights:
+                raise ValueError(
+                    f"unknown weight version {version}; staged: "
+                    f"{sorted(self._weights)}")
+            if version == self._active_version:
+                raise ValueError(
+                    f"version {version} is already active")
+            self._canary_version = int(version)
+            self._canary_fraction = fraction
+        _monitor.gauge(
+            "deploy_canary_fraction",
+            "fraction of predict traffic routed to the canary").set(
+            fraction, model=self._name)
+
+    def promote(self, version: Optional[int] = None) -> int:
+        """Atomic pointer flip: make ``version`` (default: the canary)
+        the active weights, retire the old active tree (kept only while
+        in-flight sessions pin it) and clear the canary.  Swap wall
+        time exports as ``deploy_swap_seconds``."""
+        t0 = time.perf_counter()
+        self._require_swappable()
+        with self._placed_lock:
+            if version is None:
+                version = self._canary_version
+            if version is None or version not in self._weights:
+                raise ValueError(
+                    f"cannot promote version {version}; staged: "
+                    f"{sorted(self._weights)}")
+            version = int(version)
+            old = self._active_version
+            self._active_version = version
+            if self._canary_version == version:
+                self._canary_version = None
+                self._canary_fraction = 0.0
+            if old != version and old in self._weights:
+                self._retire_locked(old)
+            self._purge_unpinned_locked()
+        # eagerly place the new active tree so the first post-swap
+        # request pays no host->device copy
+        for widx in range(len(self._devices)):
+            self._placed_params(widx, version)
+        _monitor.histogram(
+            "deploy_swap_seconds",
+            "wall time of a weight promote (pointer flip + placement)"
+        ).observe(time.perf_counter() - t0, model=self._name)
+        _monitor.gauge(
+            "deploy_version",
+            "active served weight version").set(version, model=self._name)
+        _monitor.gauge(
+            "deploy_canary_fraction",
+            "fraction of predict traffic routed to the canary").set(
+            0.0, model=self._name)
+        return version
+
+    def rollback(self) -> Optional[int]:
+        """Drop the canary: routing reverts to 100% active and the
+        canary tree is discarded (kept only while sessions pin it).
+        Returns the dropped version (None when no canary was set)."""
+        with self._placed_lock:
+            cv = self._canary_version
+            self._canary_version = None
+            self._canary_fraction = 0.0
+            if cv is not None and cv in self._weights \
+                    and cv != self._active_version:
+                self._retire_locked(cv)
+            self._purge_unpinned_locked()
+        _monitor.gauge(
+            "deploy_canary_fraction",
+            "fraction of predict traffic routed to the canary").set(
+            0.0, model=self._name)
+        return cv
+
+    def swap_weights(self, params, net_state=None,
+                     version: Optional[int] = None) -> int:
+        """Stage + promote in one call: immediately serve ``params`` as
+        the active weights (zero-recompile — executables take weights
+        as operands).  The canary path is ``stage_weights`` +
+        ``set_canary`` + ``promote``/``rollback``."""
+        v = self.stage_weights(params, net_state=net_state,
+                               version=version)
+        return self.promote(v)
+
+    def _retire_locked(self, version: int) -> None:
+        """Drop ``version`` from the servable set; its host tree is
+        retained in ``_session_pins`` while an in-flight session is
+        pinned to it (materializing the live-model sentinel if
+        needed)."""
+        if version in self._session_pinned_versions():
+            self._session_pins[version] = self._host_weights(version)
+        del self._weights[version]
+        for key in [k for k in self._placed if k[1] == version]:
+            del self._placed[key]
+
+    def _purge_unpinned_locked(self) -> None:
+        if not self._session_pins:
+            return
+        pinned = self._session_pinned_versions()
+        for v in list(self._session_pins):
+            if v not in pinned:
+                del self._session_pins[v]
+
+    def _session_pinned_versions(self):
+        s = self._sessions
+        return s.pinned_versions() if s is not None else set()
+
+    def _route_version(self, version: Optional[int] = None) -> int:
+        if version is not None:
+            v = int(version)
+            if v not in self._weights:
+                raise ValueError(
+                    f"unknown weight version {v}; staged: "
+                    f"{sorted(self._weights)}")
+            return v
+        cv, frac = self._canary_version, self._canary_fraction
+        if cv is not None and frac > 0.0:
+            # deterministic evenly-interleaved split (no burst of
+            # canary-only traffic): request i goes to the canary when
+            # the running quota floor(i*frac) ticks up
+            i = next(self._route_counter)
+            if int((i + 1) * frac) > int(i * frac):
+                return cv
+        return self._active_version
+
+    def _host_weights(self, version: int):
+        tree = self._weights[version]
+        if tree is None:   # live-model sentinel (initial version)
+            if self._quantize:
+                return (self._qparams, self._model.net_state)
+            import jax
+            # snapshot to host: the placed tuple must not alias the
+            # live model's device buffers — a concurrent fit() donates
+            # those, and a donated buffer dies under the serving
+            # executable mid-request
+            return (jax.tree_util.tree_map(np.asarray,
+                                           self._model.params),
+                    jax.tree_util.tree_map(np.asarray,
+                                           self._model.net_state))
+        return tree
+
+    def _weights_for_version(self, version: int):
+        """Host tree for a session pinned to ``version`` (None means
+        "use the model's live weights" — the initial sentinel, or a
+        version whose tree is gone)."""
+        if version in self._weights:
+            return (None if self._weights[version] is None
+                    else self._weights[version])
+        return self._session_pins.get(version)
 
     # ------------------------------------------------------- introspection
     def stats(self) -> dict:
@@ -518,9 +772,13 @@ class InferenceEngine:
             "quantize": self._quantize,
             "batch_buckets": list(self._policy.batch_buckets),
             "timestep_buckets": list(self._policy.timestep_buckets),
-            "model_bytes": self._model_bytes,
+            "model_bytes": self.model_bytes(),
             "resident_bytes": self.resident_bytes(),
             "drain_rate_rps": round(self.drain_rate(), 2),
+            "active_version": self._active_version,
+            "canary_version": self._canary_version,
+            "canary_fraction": self._canary_fraction,
+            "versions": sorted(self._weights),
         }
         if self._admission is not None:
             d["admission"] = self._admission.snapshot()
@@ -570,16 +828,22 @@ class InferenceEngine:
                 sig.append(("dense", tuple(a.shape[1:]), None))
         return tuple(sig)
 
-    def _placed_params(self, widx: int):
+    def _placed_params(self, widx: int, version: Optional[int] = None):
+        if version is None:
+            version = self._active_version
         with self._placed_lock:
-            placed = self._placed[widx]
+            if version not in self._weights:
+                # the version was promoted away or rolled back between
+                # enqueue and dispatch: serve the active tree (what the
+                # request would be routed to if resubmitted) instead of
+                # failing a request that raced a control-plane flip
+                version = self._active_version
+            placed = self._placed.get((widx, version))
             if placed is None:
                 import jax
-                src = ((self._qparams, self._model.net_state)
-                       if self._quantize
-                       else (self._model.params, self._model.net_state))
-                placed = jax.device_put(src, self._devices[widx])
-                self._placed[widx] = placed
+                placed = jax.device_put(self._host_weights(version),
+                                        self._devices[widx])
+                self._placed[(widx, version)] = placed
             return placed
 
     def _ensure_executable(self, widx: int, key) -> bool:
@@ -674,13 +938,14 @@ class InferenceEngine:
                 nxt.t_dequeue = time.perf_counter()
                 self._observe_queue_depth()
                 if (nxt.sig != req.sig
+                        or nxt.version != req.version
                         or rows + nxt.n_rows
                         > self._policy.max_batch_size):
                     pending = nxt  # seeds the next batch (FIFO-fair)
                     break
                 batch.append(nxt)
                 rows += nxt.n_rows
-            job = _BatchJob(batch, req.sig, rows)
+            job = _BatchJob(batch, req.sig, rows, req.version)
             while True:  # backpressure: wait for a worker slot
                 try:
                     self._dispatch_q.put(job, timeout=0.05)
@@ -726,7 +991,7 @@ class InferenceEngine:
             outs = outs if isinstance(outs, list) else [outs]
             outs = [np.asarray(o) for o in outs]
         else:
-            params, state = self._placed_params(widx)
+            params, state = self._placed_params(widx, job.version)
             fn = self._compiled[(widx, key)]
             if self._is_graph:
                 fmasks = (tuple(masks)
@@ -768,7 +1033,8 @@ class InferenceEngine:
                           for o in sl]
             r.future.set_result(sl[0] if len(sl) == 1 else sl)
             self._observe_latency((now - r.t_enqueue) * 1000.0,
-                                  f"{r.trace_id:032x}")
+                                  f"{r.trace_id:032x}",
+                                  version=job.version)
             off += r.n_rows
 
     def _record_batch_spans(self, job: _BatchJob, t_exec0: float,

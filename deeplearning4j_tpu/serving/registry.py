@@ -130,18 +130,41 @@ class ModelRegistry:
     # ----------------------------------------------------------- serving
     def predict(self, name: str, features, *,
                 session: Optional[str] = None,
-                timeout: Optional[float] = None, block: bool = True):
+                timeout: Optional[float] = None, block: bool = True,
+                version: Optional[int] = None):
         """Route one request to ``name``, paging its weights in first.
 
         With ``session=``, routes through the engine's device-resident
         session cache (one timestep dispatch); otherwise through the
-        dynamic batcher.  Raises :class:`UnknownModel` / ``QueueFull`` /
-        ``SloShed`` per the usual contracts.
+        dynamic batcher.  ``version=`` pins the request to a staged
+        weight version (the rollout controller's probe path).  Raises
+        :class:`UnknownModel` / ``QueueFull`` / ``SloShed`` per the
+        usual contracts.
         """
         engine = self._touch(name)
         if session is not None:
             return engine.predict_session(session, features)
-        return engine.predict(features, timeout=timeout, block=block)
+        return engine.predict(features, timeout=timeout, block=block,
+                              version=version)
+
+    # --------------------------------------------------------- deployment
+    def swap_weights(self, name: str, params, *,
+                     net_state=None, version: Optional[int] = None) -> int:
+        """Hot-swap ``name``'s served weights (stage + atomic promote,
+        zero recompile — executables take weights as call operands).
+        Pages the model in first so the swap lands on device under the
+        budget.  Returns the new active version.  The canaried path is
+        :class:`~deeplearning4j_tpu.deploy.rollout.RolloutController`,
+        which drives ``stage_weights``/``set_canary``/``promote``/
+        ``rollback`` on the engine directly."""
+        engine = self._touch(name)
+        v = engine.swap_weights(params, net_state=net_state,
+                                version=version)
+        with self._lock:
+            # a staged/retired tree changes the model's byte footprint;
+            # re-run the budget so accounting stays truthful
+            self._page_in_locked(str(name))
+        return v
 
     def _touch(self, name: str) -> InferenceEngine:
         """LRU-touch ``name`` and guarantee its weights are resident."""
@@ -224,6 +247,10 @@ class ModelRegistry:
                     "backend": es["backend"],
                     "queue_depth": es["queue_depth"],
                     "slo_p99_ms": eng.slo_p99_ms,
+                    "version": es["active_version"],
+                    "canary_version": es["canary_version"],
+                    "canary_fraction": es["canary_fraction"],
+                    "versions": es["versions"],
                 }
             return {
                 "hbm_budget_bytes": self._budget,

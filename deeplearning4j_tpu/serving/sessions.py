@@ -31,6 +31,16 @@ Thread safety: the cache map has its own lock; each session serializes
 its steps on a per-session lock (state is a chain — two concurrent
 steps for one session would fork it) while distinct sessions dispatch
 concurrently.
+
+Version pinning (docs/DEPLOY.md): a session's carry pytree is a
+function of the weights that produced it, so advancing old state with
+new weights after a hot-swap would chain two different models'
+dynamics.  Each session records the engine's active weight version at
+creation (``version_fn``) and every subsequent step resolves that
+SAME version's host tree (``weights_fn``) until the session ends or
+its TTL expires — the engine retains a retired version's tree while
+any session pins it.  ``serving_session_version_pinned`` gauges how
+many live sessions are pinned behind the active version.
 """
 
 from __future__ import annotations
@@ -52,14 +62,17 @@ class SessionError(RuntimeError):
 
 
 class _Session:
-    __slots__ = ("carries", "batch", "last_used", "lock", "steps")
+    __slots__ = ("carries", "batch", "last_used", "lock", "steps",
+                 "version")
 
-    def __init__(self, carries, batch: int):
+    def __init__(self, carries, batch: int,
+                 version: Optional[int] = None):
         self.carries = carries
         self.batch = batch
         self.last_used = time.monotonic()
         self.lock = threading.Lock()
         self.steps = 0
+        self.version = version
 
 
 class SessionCache:
@@ -72,7 +85,8 @@ class SessionCache:
     """
 
     def __init__(self, model, *, ttl_s: float = 300.0,
-                 max_sessions: int = 1024, name: str = "default"):
+                 max_sessions: int = 1024, name: str = "default",
+                 version_fn=None, weights_fn=None):
         from ..nn.computation_graph import ComputationGraph
         model.init()
         model._require_carry_support("SessionCache")
@@ -85,12 +99,25 @@ class SessionCache:
         self._name = str(name)
         self._sessions: "OrderedDict[str, _Session]" = OrderedDict()
         self._lock = threading.Lock()
+        # deployment hooks (set by InferenceEngine): version_fn() is the
+        # engine's active weight version at session creation; weights_fn(v)
+        # resolves the pinned version's host tree (None = live weights)
+        self._version_fn = version_fn
+        self._weights_fn = weights_fn
 
     # ------------------------------------------------------------- metrics
     def _observe_active(self) -> None:
         _monitor.gauge("serving_sessions_active",
                        "live device-resident RNN sessions").set(
             len(self._sessions), model=self._name)
+        if self._version_fn is not None:
+            active = self._version_fn()
+            pinned = sum(1 for s in self._sessions.values()
+                         if s.version is not None and s.version != active)
+            _monitor.gauge(
+                "serving_session_version_pinned",
+                "live sessions pinned to a non-active weight version"
+            ).set(pinned, model=self._name)
 
     def _count_eviction(self, reason: str) -> None:
         _monitor.counter("serving_session_evictions_total",
@@ -132,14 +159,21 @@ class SessionCache:
                     f"session {session_id!r} holds state for batch size "
                     f"{sess.batch}, got {batch}; clear() the session "
                     "between unrelated sequences")
+            # Version pinning: a session created before a weight swap
+            # keeps stepping with the version its carries came from.
+            kw = {}
+            if self._weights_fn is not None and sess.version is not None:
+                w = self._weights_fn(sess.version)
+                if w is not None:
+                    kw = {"params": w[0], "net_state": w[1]}
             # ONE dispatch: explicit-carry step, carries stay on device
             if self._is_graph:
                 outs, sess.carries = self._model.rnn_stateless_step(
-                    sess.carries, *arrays)
+                    sess.carries, *arrays, **kw)
                 out = outs[0] if len(outs) == 1 else outs
             else:
                 out, sess.carries = self._model.rnn_stateless_step(
-                    sess.carries, x)
+                    sess.carries, x, **kw)
             sess.steps += 1
             sess.last_used = time.monotonic()
         _monitor.counter("serving_session_steps_total",
@@ -162,8 +196,10 @@ class SessionCache:
                     self._sessions.popitem(last=False)   # LRU out
                     self._count_eviction("capacity")
                 carries = self._model._init_carries(batch)
-                sess = self._sessions[session_id] = _Session(carries,
-                                                             batch)
+                version = (self._version_fn()
+                           if self._version_fn is not None else None)
+                sess = self._sessions[session_id] = _Session(
+                    carries, batch, version)
             else:
                 self._sessions.move_to_end(session_id)   # LRU touch
             self._observe_active()
@@ -191,6 +227,20 @@ class SessionCache:
             self._sessions.clear()
             self._observe_active()
 
+    def pinned_versions(self):
+        """Weight versions pinned by at least one live session — what
+        the engine consults before discarding a retired tree."""
+        with self._lock:
+            return {s.version for s in self._sessions.values()
+                    if s.version is not None}
+
+    def session_version(self, session_id: str) -> Optional[int]:
+        """The weight version ``session_id`` is pinned to (None for
+        unknown sessions or un-versioned caches)."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return None if sess is None else sess.version
+
     def get_carries(self, session_id: str):
         """The session's carry pytree (device arrays), or None —
         ``rnn_get_previous_state`` lifted to named sessions."""
@@ -214,4 +264,7 @@ class SessionCache:
                          self._sessions.values()), default=0.0), 3),
                 "total_steps": sum(s.steps
                                    for s in self._sessions.values()),
+                "pinned_versions": sorted(
+                    {s.version for s in self._sessions.values()
+                     if s.version is not None}),
             }

@@ -363,6 +363,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.health_data())
         elif path == "/models":
             self._json(ui.models_data())
+        elif path == "/deploy":
+            self._json(ui.deploy_data())
         else:
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
@@ -462,12 +464,66 @@ class _Handler(BaseHTTPRequestHandler):
             body = {"output": _np.asarray(out).tolist()}
         self._json(body)
 
+    # ---- POST /deploy/{model} (rollout control plane) --------------------
+    def _deploy_post(self, ui: "UIServer", model: str) -> None:
+        """``{"action": "push"|"promote"|"rollback"|"step",
+        "version": N?}``.  Corrupt snapshots 400 (manifest SHA
+        mismatch, no swap happens); control-plane misuse 409; an
+        unattached model 404.  Every success echoes the controller's
+        full status."""
+        from ..deploy.rollout import RolloutError
+        from ..deploy.store import WeightStoreCorruptError
+        ctl = ui.get_deployment(model)
+        if ctl is None:
+            self._send(404, json.dumps(
+                {"error": f"no deployment attached for model {model!r}",
+                 "deployments": sorted(ui.deployments())}).encode())
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            payload = json.loads(self.rfile.read(length).decode()) \
+                if length else {}
+            action = payload.get("action", "push")
+            version = payload.get("version")
+            version = int(version) if version is not None else None
+            if action == "push":
+                result = {"pushed": ctl.push(version)}
+            elif action == "promote":
+                result = {"promoted": ctl.promote()}
+            elif action == "rollback":
+                result = {"rolled_back": ctl.rollback(
+                    reason=str(payload.get("reason", "http")))}
+            elif action == "step":
+                result = {"action": ctl.step()}
+            else:
+                raise ValueError(
+                    f"unknown action {action!r}; expected push/promote/"
+                    "rollback/step")
+        except WeightStoreCorruptError as e:
+            self._send(400, json.dumps(
+                {"error": str(e), "corrupt": True}).encode())
+            return
+        except RolloutError as e:
+            self._send(409, json.dumps({"error": str(e)}).encode())
+            return
+        except KeyError as e:
+            self._send(404, json.dumps({"error": str(e)}).encode())
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, json.dumps({"error": str(e)}).encode())
+            return
+        result["status"] = ctl.status()
+        self._json(result)
+
     # ---- POST /remote (RemoteUIStatsStorageRouter receiver) + /tsne ------
     def do_POST(self):
         ui: "UIServer" = self.server.ui            # type: ignore
         path = urlparse(self.path).path.rstrip("/")
         if path == "/predict":
             self._predict(ui)
+            return
+        if path.startswith("/deploy/"):
+            self._deploy_post(ui, path[len("/deploy/"):])
             return
         if path not in ("/remote", "/tsne/upload"):
             # Route before touching the body: unknown paths must 404 even
@@ -509,6 +565,7 @@ class UIServer:
         self._tsne: dict = {"coords": [], "labels": None}
         self._engines: dict = {}
         self._registry = None
+        self._deployments: dict = {}
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
@@ -547,6 +604,29 @@ class UIServer:
 
     def get_registry(self):
         return self._registry
+
+    # ---- deployment control plane (POST /deploy/{model}) -----------------
+    def attach_deployment(self, controller) -> "UIServer":
+        """Expose a :class:`~deeplearning4j_tpu.deploy.RolloutController`
+        behind ``POST /deploy/{model}`` (push / promote / rollback /
+        step) and ``GET /deploy`` (per-model rollout status)."""
+        self._deployments[controller.model] = controller
+        return self
+
+    def detach_deployment(self, name: str) -> "UIServer":
+        self._deployments.pop(name, None)
+        return self
+
+    def get_deployment(self, name: str):
+        return self._deployments.get(name)
+
+    def deployments(self):
+        return list(self._deployments)
+
+    def deploy_data(self) -> dict:
+        """``GET /deploy`` body: every attached controller's status."""
+        return {name: ctl.status()
+                for name, ctl in self._deployments.items()}
 
     def models_data(self) -> dict:
         """``GET /models`` body: the registry hosting view plus any

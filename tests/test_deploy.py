@@ -1,0 +1,414 @@
+"""Zero-downtime deployment tests (``deploy/``): the versioned weight
+store's manifest verification and stamp ordering, the engine's
+stage/canary/promote/rollback machinery (zero recompiles — weights are
+call operands), the rollout controller's gates and auto-rollback, the
+fit()-side publishers, session version pinning across a swap, and the
+stamp-ordered ``CheckpointManager.latest()``."""
+
+import io
+import json
+import os
+import shutil
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                monitor)
+from deeplearning4j_tpu.deploy import (DeploymentListener,
+                                       RolloutController, RolloutError,
+                                       VersionedWeightStore,
+                                       WeightStoreCorruptError,
+                                       tree_from_flat)
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (GravesLSTM,
+                                                    RnnOutputLayer)
+from deeplearning4j_tpu.serving import InferenceEngine, ModelRegistry
+
+
+def _dense_model(seed=7, n_in=4, hidden=8, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater("sgd").learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_out=hidden))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(inputs.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _rnn_model(seed=7, n_in=3, hidden=8, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .dtype("float64")
+            .list()
+            .layer(GravesLSTM(n_out=hidden))
+            .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(inputs.recurrent(n_in, 6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _corrupt_entry(path, name="flat.bin"):
+    """Rewrite one zip entry's bytes while keeping the (now stale)
+    manifest — a guaranteed SHA-256 mismatch.  Flipping a raw byte of
+    the file is NOT a reliable corruption: zip readers resolve entries
+    through the central directory and ignore damaged local headers."""
+    with zipfile.ZipFile(path) as zf:
+        entries = {n: zf.read(n) for n in zf.namelist()}
+    data = bytearray(entries[name])
+    data[len(data) // 2] ^= 0xFF
+    entries[name] = bytes(data)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for n, b in entries.items():
+            zf.writestr(n, b)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def _compiles(name):
+    total = 0.0
+    snap = monitor.snapshot().get("serving_bucket_compiles_total", {})
+    for labels, v in snap.get("values", {}).items():
+        if f'engine="{name}"' in labels:
+            total += v
+    return total
+
+
+# ---- VersionedWeightStore ------------------------------------------------
+
+def test_store_publish_load_roundtrip(tmp_path):
+    store = VersionedWeightStore(str(tmp_path))
+    assert store.latest() is None
+    flat = np.arange(24, dtype=np.float32)
+    v1 = store.publish(flat, step=5, source="test", meta={"k": "v"})
+    assert v1 == 1 and store.latest() == 1
+    snap = store.load(1)
+    np.testing.assert_array_equal(snap.flat, flat)
+    assert snap.step == 5 and snap.source == "test"
+    assert snap.meta == {"k": "v"}
+    assert store.verify(1)
+
+
+def test_store_versions_are_monotonic(tmp_path):
+    store = VersionedWeightStore(str(tmp_path))
+    flat = np.zeros(4, dtype=np.float32)
+    assert store.publish(flat) == 1
+    assert store.publish(flat, version=7) == 7
+    with pytest.raises(ValueError):
+        store.publish(flat, version=7)
+    with pytest.raises(ValueError):
+        store.publish(flat, version=3)
+    assert store.publish(flat) == 8
+    assert store.versions() == [1, 7, 8]
+
+
+def test_store_prunes_to_keep_last(tmp_path):
+    store = VersionedWeightStore(str(tmp_path), keep_last=2)
+    flat = np.zeros(4, dtype=np.float32)
+    for _ in range(5):
+        store.publish(flat)
+    assert store.versions() == [4, 5]
+    with pytest.raises(KeyError):
+        store.load(1)
+
+
+def test_store_orders_by_stamp_not_filename(tmp_path):
+    """A snapshot copied to a higher-numbered FILENAME must not shadow
+    the genuinely newest version: ordering reads the stamp inside the
+    zip."""
+    store = VersionedWeightStore(str(tmp_path))
+    store.publish(np.full(4, 1.0, dtype=np.float32))     # v1
+    store.publish(np.full(4, 2.0, dtype=np.float32))     # v2
+    # copy v1's payload to a v9-looking filename
+    shutil.copy(os.path.join(str(tmp_path), "weights-v%010d.zip" % 1),
+                os.path.join(str(tmp_path), "weights-v%010d.zip" % 9))
+    assert store.latest() == 2
+    assert store.load(store.latest()).flat[0] == 2.0
+
+
+def test_store_detects_corruption(tmp_path):
+    store = VersionedWeightStore(str(tmp_path))
+    v = store.publish(np.arange(16, dtype=np.float32))
+    path = os.path.join(str(tmp_path), "weights-v%010d.zip" % v)
+    _corrupt_entry(path)
+    assert not store.verify(v)
+    with pytest.raises(WeightStoreCorruptError):
+        store.load(v)
+
+
+def test_tree_from_flat_roundtrip():
+    net = _dense_model(seed=3)
+    flat = net.get_flat_params()
+    tree = tree_from_flat(net, np.asarray(flat))
+    for built, ref in zip(tree, net.params):
+        assert sorted(built) == sorted(ref)
+        for k in ref:
+            np.testing.assert_allclose(np.asarray(built[k]),
+                                       np.asarray(ref[k]))
+    with pytest.raises(ValueError):
+        tree_from_flat(net, np.zeros(3, dtype=np.float32))
+
+
+# ---- engine hot-swap -----------------------------------------------------
+
+def test_engine_swap_serves_new_weights_without_recompile():
+    net, net2 = _dense_model(seed=1), _dense_model(seed=2)
+    x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    with InferenceEngine(net, max_batch_size=4, max_latency_ms=0.5,
+                         name="swap-basic") as eng:
+        eng.warmup((4,))
+        before = np.asarray(eng.predict(x))
+        compiles0 = _compiles("swap-basic")
+        v = eng.swap_weights(net2.params, net_state=net2.net_state)
+        after = np.asarray(eng.predict(x))
+        assert _compiles("swap-basic") == compiles0
+        assert eng.active_version == v == 1
+        assert not np.allclose(before, after)
+        np.testing.assert_allclose(after, np.asarray(net2.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_engine_canary_routes_fraction_then_promote():
+    net, net2 = _dense_model(seed=1), _dense_model(seed=2)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    with InferenceEngine(net, max_batch_size=4, max_latency_ms=0.5,
+                         name="swap-canary") as eng:
+        eng.warmup((4,))
+        v = eng.stage_weights(net2.params, net_state=net2.net_state)
+        eng.set_canary(v, fraction=0.5)
+        assert eng.canary_version == v
+        ref_old = np.asarray(net.output(x))
+        ref_new = np.asarray(net2.output(x))
+        hits_old = hits_new = 0
+        for _ in range(20):
+            out = np.asarray(eng.predict(x))
+            if np.allclose(out, ref_new, rtol=1e-5, atol=1e-6):
+                hits_new += 1
+            elif np.allclose(out, ref_old, rtol=1e-5, atol=1e-6):
+                hits_old += 1
+        # deterministic 50/50 split: both versions actually serve
+        assert hits_old == 10 and hits_new == 10
+        # explicit version routing overrides the split
+        np.testing.assert_allclose(
+            np.asarray(eng.predict(x, version=v)), ref_new,
+            rtol=1e-5, atol=1e-6)
+        eng.promote(v)
+        assert eng.active_version == v
+        assert eng.canary_version is None
+        np.testing.assert_allclose(np.asarray(eng.predict(x)), ref_new,
+                                   rtol=1e-5, atol=1e-6)
+        # the retired tree is gone: explicit version-0 asks now fail
+        with pytest.raises(Exception):
+            eng.predict(x, version=0)
+
+
+def test_engine_rollback_restores_active():
+    net, net2 = _dense_model(seed=1), _dense_model(seed=2)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    with InferenceEngine(net, max_batch_size=4, max_latency_ms=0.5,
+                         name="swap-rb") as eng:
+        eng.warmup((4,))
+        ref = np.asarray(eng.predict(x))
+        v = eng.stage_weights(net2.params, net_state=net2.net_state)
+        eng.set_canary(v, fraction=1.0)
+        dropped = eng.rollback()
+        assert dropped == v and eng.canary_version is None
+        assert eng.active_version == 0
+        np.testing.assert_allclose(np.asarray(eng.predict(x)), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_engine_stage_rejects_stale_versions():
+    net, net2 = _dense_model(seed=1), _dense_model(seed=2)
+    with InferenceEngine(net, max_batch_size=4, name="swap-stale") as eng:
+        v = eng.stage_weights(net2.params, net_state=net2.net_state,
+                              version=5)
+        with pytest.raises(ValueError):
+            eng.stage_weights(net2.params, net_state=net2.net_state,
+                              version=5)
+        with pytest.raises(ValueError):
+            eng.stage_weights(net2.params, net_state=net2.net_state,
+                              version=2)
+        assert eng.versions() == [0, 5]
+        eng.promote(v)
+        assert eng.versions() == [5]
+
+
+def test_engine_int8_refuses_hot_swap():
+    from deeplearning4j_tpu.serving import ServingError
+    net, net2 = _dense_model(seed=1), _dense_model(seed=2)
+    with InferenceEngine(net, max_batch_size=4, quantize="int8",
+                         name="swap-int8") as eng:
+        with pytest.raises(ServingError):
+            eng.stage_weights(net2.params, net_state=net2.net_state)
+
+
+# ---- RolloutController ---------------------------------------------------
+
+def _registry_with(net, name="m"):
+    reg = ModelRegistry()
+    reg.register(name,
+                 InferenceEngine(net, max_batch_size=16,
+                                 max_latency_ms=0.5, name=name),
+                 warmup_shape=(4,))
+    return reg
+
+
+def _eval_set(net, n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    y = np.asarray(net.output(X))
+    return X, np.eye(y.shape[1], dtype=np.float32)[np.argmax(y, -1)]
+
+
+def test_controller_push_probe_promote(tmp_path):
+    net = _dense_model(seed=1)
+    reg = _registry_with(net)
+    store = VersionedWeightStore(str(tmp_path))
+    # "trained" update: the same net published -> agreement is 1.0
+    store.publish(np.asarray(net.get_flat_params()))
+    Xe, ye = _eval_set(net)
+    ctl = RolloutController(reg, "m", store, eval_features=Xe,
+                            eval_labels=ye, min_probe_rounds=2)
+    assert ctl.step() == "push"
+    assert ctl.state == "canary"
+    with pytest.raises(RolloutError):
+        ctl.push()                      # one canary at a time
+    assert ctl.step() == "probe"
+    assert ctl.step() == "promote"
+    assert ctl.state == "idle"
+    assert reg.get("m").active_version == 1
+    assert ctl.step() == "noop"
+    assert reg.stats()["models"]["m"]["version"] == 1
+
+
+def test_controller_bad_update_rolls_back_with_bundle(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_MIN_INTERVAL_S", "0")
+    net = _dense_model(seed=1)
+    reg = _registry_with(net)
+    store = VersionedWeightStore(str(tmp_path / "store"))
+    rng = np.random.RandomState(9)
+    n = np.asarray(net.get_flat_params()).size
+    bad = store.publish(rng.randn(n).astype(np.float32) * 100.0,
+                        source="bad")
+    Xe, ye = _eval_set(net)
+    ctl = RolloutController(reg, "m", store, eval_features=Xe,
+                            eval_labels=ye, min_probe_rounds=1)
+    assert ctl.step() == "push"
+    assert ctl.step() == "rollback"
+    assert ctl.state == "idle"
+    assert reg.get("m").active_version == 0
+    assert bad in ctl.quarantined
+    assert ctl.last_bundle and os.path.isdir(ctl.last_bundle)
+    # quarantined: the poll loop must not ping-pong on the bad version
+    assert ctl.step() == "noop"
+    with pytest.raises(RolloutError):
+        ctl.push(bad)
+
+
+def test_controller_refuses_corrupt_snapshot(tmp_path):
+    net = _dense_model(seed=1)
+    reg = _registry_with(net)
+    store = VersionedWeightStore(str(tmp_path))
+    v = store.publish(np.asarray(net.get_flat_params()))
+    _corrupt_entry(os.path.join(str(tmp_path),
+                                "weights-v%010d.zip" % v))
+    ctl = RolloutController(reg, "m", store)
+    with pytest.raises(WeightStoreCorruptError):
+        ctl.push(v)
+    assert ctl.state == "idle"
+    assert reg.get("m").active_version == 0
+    assert reg.get("m").canary_version is None
+
+
+# ---- publishers ----------------------------------------------------------
+
+def test_deployment_listener_publishes_from_fit(tmp_path):
+    store = VersionedWeightStore(str(tmp_path))
+    net = _dense_model(seed=5)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, size=64)]
+    listener = DeploymentListener(store, every_n_iterations=2)
+    net.set_listeners(listener)
+    net.fit(X, y, epochs=2)
+    assert listener.published
+    assert store.versions() == listener.published
+    # the published head reproduces the live model's weights
+    snap = store.load(store.latest())
+    np.testing.assert_allclose(snap.flat,
+                               np.asarray(net.get_flat_params(),
+                                          dtype=np.float32),
+                               rtol=1e-6, atol=1e-7)
+    assert snap.source in ("fit", "fit_epoch")
+
+
+# ---- session version pinning --------------------------------------------
+
+def test_sessions_stay_pinned_across_promote():
+    """A session opened on version N keeps stepping N's weights after
+    a promote to N+1 (no mid-stream distribution shift); fresh sessions
+    bind to N+1; the pinned gauge counts the stragglers."""
+    net, net2 = _rnn_model(seed=1), _rnn_model(seed=2)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(2, 6, 3)
+    with InferenceEngine(net, max_batch_size=4, max_latency_ms=0.5,
+                         name="pin") as eng:
+        # reference: an engine that never swaps
+        with InferenceEngine(net, max_batch_size=4, max_latency_ms=0.5,
+                             name="pin-ref") as ref_eng:
+            a0 = eng.predict_session("s", xs[:, 0])
+            r0 = ref_eng.predict_session("s", xs[:, 0])
+            np.testing.assert_allclose(a0, r0, rtol=0, atol=1e-12)
+            v = eng.swap_weights(net2.params, net_state=net2.net_state)
+            assert eng.active_version == v
+            gauge = monitor.gauge("serving_session_version_pinned", "")
+            # old session: still version 0's recurrence, bit-for-bit
+            for t in range(1, 6):
+                np.testing.assert_allclose(
+                    eng.predict_session("s", xs[:, t]),
+                    ref_eng.predict_session("s", xs[:, t]),
+                    rtol=0, atol=1e-12)
+            assert gauge.value(model="pin") >= 1
+            assert eng.sessions.session_version("s") == 0
+            assert 0 in eng.sessions.pinned_versions()
+        # a NEW session binds to the new version's weights
+        with InferenceEngine(net2, max_batch_size=4, max_latency_ms=0.5,
+                             name="pin-new") as new_eng:
+            for t in range(3):
+                np.testing.assert_allclose(
+                    eng.predict_session("fresh", xs[:, t]),
+                    new_eng.predict_session("fresh", xs[:, t]),
+                    rtol=0, atol=1e-12)
+            assert eng.sessions.session_version("fresh") == 1
+
+
+# ---- checkpoint stamp ordering ------------------------------------------
+
+def test_checkpoint_latest_orders_by_stamp_not_filename(tmp_path):
+    from deeplearning4j_tpu.resilience.checkpoint import (
+        CheckpointManager, checkpoint_stamp)
+    net = _dense_model(seed=5)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, size=32)]
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    net.fit(X, y, epochs=1)
+    p1 = mgr.save(net)
+    net.fit(X, y, epochs=1)
+    p2 = mgr.save(net)
+    assert mgr.latest() == p2
+    s1, s2 = checkpoint_stamp(p1), checkpoint_stamp(p2)
+    assert s1 is not None and s2 is not None and s2 > s1
+    # copy the OLD checkpoint to a higher-numbered filename: a
+    # filename sort would pick it; the stamp sort must not
+    decoy = os.path.join(str(tmp_path), "checkpoint-%010d.zip" % 999)
+    shutil.copy(p1, decoy)
+    assert checkpoint_stamp(decoy) == s1
+    assert mgr.latest() == p2

@@ -223,3 +223,92 @@ def test_registry_unregister_releases():
         assert "a" not in reg
     finally:
         reg.stop_all()
+
+
+# ---- deployment x paging -------------------------------------------------
+
+def _bucket_compiles(name):
+    total = 0.0
+    snap = monitor.snapshot().get("serving_bucket_compiles_total", {})
+    for labels, v in snap.get("values", {}).items():
+        if f'engine="{name}"' in labels:
+            total += v
+    return total
+
+
+def test_registry_model_bytes_counts_staged_canary():
+    """A staged canary doubles the model's pageable footprint; promote
+    retires the old tree and the footprint drops back to one copy."""
+    eng = _engine(31)
+    donor = _dense_model(hidden=8, seed=32)
+    try:
+        per = eng.model_bytes()
+        v = eng.stage_weights(donor.params, net_state=donor.net_state)
+        assert eng.model_bytes() == 2 * per
+        eng.promote(v)
+        assert eng.model_bytes() == per
+    finally:
+        eng.stop()
+
+
+def test_registry_page_out_preserves_staged_canary():
+    """HBM pressure from OTHER tenants pages out a model with a canary
+    in flight: the staged tree must survive on host and come back on
+    demand — an explicit canary-version request transparently re-pages
+    BOTH versions in with zero new compiles."""
+    probe = _engine(97)
+    per = probe.model_bytes()
+    probe.stop()
+    reg = ModelRegistry(hbm_budget_bytes=int(2.5 * per))
+    try:
+        a = reg.register("ma", _engine(41, name="ma"))
+        donor = _dense_model(hidden=8, seed=42)
+        x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+        ref_active = np.asarray(reg.predict("ma", x, timeout=60.0))
+        cv = a.stage_weights(donor.params, net_state=donor.net_state)
+        a.set_canary(cv, fraction=0.0)        # staged, not yet routed
+        # pressure: two more tenants under a ~2.5-copy budget ->
+        # "ma" (the LRU) pages out; its staged tree stays on host
+        reg.register("mb", _engine(43, name="mb"))
+        reg.register("mc", _engine(44, name="mc"))
+        st = reg.stats()["models"]
+        assert not st["ma"]["resident"]
+        assert a.canary_version == cv          # control plane survives
+        compiles0 = _bucket_compiles("ma")
+        out = np.asarray(reg.predict("ma", x, timeout=60.0, version=cv))
+        np.testing.assert_allclose(out, np.asarray(donor.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+        assert _bucket_compiles("ma") == compiles0   # pure data motion
+        st = reg.stats()["models"]
+        assert st["ma"]["resident"]
+        assert reg.resident_bytes() <= int(2.5 * per)
+        # the active tree came back too, not just the canary
+        np.testing.assert_allclose(
+            np.asarray(reg.predict("ma", x, timeout=60.0, version=0)),
+            ref_active, rtol=1e-5, atol=1e-6)
+    finally:
+        reg.stop_all()
+
+
+def test_registry_swap_weights_keeps_budget_accounting():
+    """registry.swap_weights: zero-recompile pointer flip through the
+    registry, with the byte accounting re-run after the retire."""
+    reg = ModelRegistry()
+    try:
+        eng = reg.register("sw", _engine(51, name="sw"))
+        donor = _dense_model(hidden=8, seed=52)
+        x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
+        np.asarray(reg.predict("sw", x, timeout=60.0))   # warm bucket
+        compiles0 = _bucket_compiles("sw")
+        v = reg.swap_weights("sw", donor.params,
+                             net_state=donor.net_state)
+        assert eng.active_version == v
+        np.testing.assert_allclose(
+            np.asarray(reg.predict("sw", x, timeout=60.0)),
+            np.asarray(donor.output(x)), rtol=1e-5, atol=1e-6)
+        assert _bucket_compiles("sw") == compiles0
+        assert reg.stats()["models"]["sw"]["version"] == v
+        # one copy resident again after the retire
+        assert eng.model_bytes() == eng.resident_bytes()
+    finally:
+        reg.stop_all()
