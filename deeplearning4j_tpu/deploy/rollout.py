@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
 from .store import VersionedWeightStore, tree_from_flat
 
 IDLE = "idle"
@@ -114,7 +115,7 @@ class RolloutController:
         self.last_bundle: Optional[str] = None
         self.quarantined: set = set()
         self._probe_rounds = 0
-        self._lock = threading.RLock()
+        self._lock = make_lock("deploy.rollout", rlock=True)
         eng = registry.get(self.model)
         _monitor.gauge("deploy_version",
                        "active served weight version").set(

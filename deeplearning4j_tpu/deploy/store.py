@@ -52,6 +52,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
 from ..resilience.checkpoint import _atomic_write_bytes, _sha256
 
 STORE_PREFIX = "weights-v"
@@ -109,7 +110,7 @@ class VersionedWeightStore:
             raise ValueError("keep_last must be >= 1")
         self.keep_last = int(keep_last)
         os.makedirs(self.directory, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = make_lock("deploy.store")
 
     # ------------------------------------------------------------ writing
     def publish(self, flat, *, step: int = 0, version: Optional[int] = None,

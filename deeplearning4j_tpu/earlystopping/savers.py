@@ -35,23 +35,11 @@ class LocalFileModelSaver:
         os.makedirs(directory, exist_ok=True)
 
     def _write(self, net, name: str) -> None:
-        # Atomic: a crash mid-write must never leave a torn
-        # bestModel.bin where a valid one used to be — serialize to a
-        # temp file in the same directory, fsync, then rename over.
+        # write_model is atomic for path targets (utils.fileio): a crash
+        # mid-write never leaves a torn bestModel.bin where a valid one
+        # used to be
         from ..utils.model_serializer import write_model
-        final = os.path.join(self.directory, name)
-        tmp = os.path.join(self.directory, f".tmp-{name}.{os.getpid()}")
-        try:
-            write_model(net, tmp)
-            fd = os.open(tmp, os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-            os.replace(tmp, final)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        write_model(net, os.path.join(self.directory, name))
 
     def _read(self, net_cls_hint, name: str):
         from ..utils.model_serializer import (restore_computation_graph,

@@ -51,6 +51,7 @@ import time
 from typing import Any, Dict, Optional
 
 from . import health as _health
+from ..utils.fileio import atomic_write_json
 from .metrics import registry
 from .tracing import current_context, tracer
 
@@ -103,8 +104,9 @@ def reset_rate_limit() -> None:
 
 
 def _write_json(path: str, obj: Any) -> None:
-    with open(path, "w") as f:
-        json.dump(obj, f, indent=1, default=str)
+    # atomic: a bundle is read by humans mid-incident; a torn JSON file
+    # during a crash loop would point the post-mortem at the recorder
+    atomic_write_json(path, obj, indent=1, default=str)
 
 
 def _prune(parent: str, keep: int) -> None:

@@ -1,0 +1,42 @@
+"""Lock constructor factory: plain locks in production, instrumented
+lock-order tracking under ``DL4J_TPU_LOCK_DEBUG=1``.
+
+Every multi-threaded subsystem (serving, scaleout, streaming, deploy,
+resilience) builds its locks through :func:`make_lock` with a stable
+dotted site name.  Off (the default) this returns a bare
+``threading.Lock``/``RLock`` — zero wrapper, zero overhead.  On, it
+returns ``tools.analyze.lockgraph.InstrumentedLock``, which records the
+per-thread acquisition graph, detects lock-order cycles (deadlock
+hazards), counts long holds, and publishes ``lockgraph_*`` metrics —
+see ``docs/ANALYSIS.md``.
+
+The import of ``tools.analyze`` is lazy and fault-tolerant: an
+installed package without the repo's ``tools/`` tree silently falls
+back to plain locks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_FLAG = "DL4J_TPU_LOCK_DEBUG"
+
+
+def debug_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") in ("1", "true", "yes")
+
+
+def make_lock(name: str, rlock: bool = False):
+    """A lock for the call site named ``name`` (``"package.role"``
+    convention, e.g. ``"serving.engine.placed"``).  Instrumented only
+    when ``DL4J_TPU_LOCK_DEBUG=1`` and the analyzer package is
+    importable."""
+    if debug_enabled():
+        try:
+            from tools.analyze import lockgraph
+        except ImportError:
+            pass
+        else:
+            return lockgraph.instrumented_lock(name, rlock=rlock)
+    return threading.RLock() if rlock else threading.Lock()

@@ -156,6 +156,7 @@ class ParamAndGradientIterationListener(IterationListener):
         if file_path:
             # truncate once; appends follow (reference opens with append
             # after an initial header write)
+            # dl4j-lint: disable=R2 append-log truncation, not a final-file write; rows stream in afterwards so rename-into-place has nothing to protect
             open(file_path, "w").close()
 
     @staticmethod
@@ -395,6 +396,7 @@ class CheckpointListener(TrainingListener):
         import os
         import threading
 
+        from ...utils.fileio import atomic_write_bytes
         from ...utils.model_serializer import write_model
 
         if iteration == self._last_saved_iter:
@@ -407,16 +409,13 @@ class CheckpointListener(TrainingListener):
         data = buf.getvalue()
         path = os.path.join(self.dir, f"checkpoint_{iteration}.zip")
 
-        # unique tmp per writer: two checkpoints of the SAME iteration in
-        # one listener lifetime (restore+retrain, fit after iteration
-        # reset) must not interleave partial writes on one tmp file
-        tmp = f"{path}.tmp.{len(self.saved)}-{id(data)}"
-
         def write():
             try:
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                os.replace(tmp, path)   # atomic on POSIX
+                # atomic_write mkstemps its own unique tmp, so two
+                # checkpoints of the SAME iteration in one listener
+                # lifetime (restore+retrain, fit after iteration reset)
+                # never interleave partial writes on one tmp file
+                atomic_write_bytes(path, data)
             except BaseException as e:  # surfaced by flush()
                 self._write_errors.append((path, e))
 

@@ -37,6 +37,8 @@ import sys
 import tempfile
 from typing import Dict, Optional
 
+from ..utils.fileio import atomic_write_json
+
 SCORES_JSONL = "scores.jsonl"
 DONE_JSON = "done.json"
 CKPT_DIR = "checkpoints"
@@ -110,11 +112,14 @@ def child_main(workdir: str, epochs: int, every_steps: int,
                              every_steps=every_steps, keep_last=4)
     net.fit(it, epochs=epochs, checkpoint=ckpt,
             resume_from="auto" if resume else None)
-    with open(os.path.join(workdir, DONE_JSON), "w") as fh:
-        json.dump({"params_sha256": _params_sha256(net),
-                   "iteration": int(net.iteration),
-                   "epoch": int(net.epoch),
-                   "score": float(net.score())}, fh)
+    # atomic: the parent polls for DONE_JSON while the child may be
+    # killed at any instant — a torn marker would read as a torn run
+    atomic_write_json(
+        os.path.join(workdir, DONE_JSON),
+        {"params_sha256": _params_sha256(net),
+         "iteration": int(net.iteration),
+         "epoch": int(net.epoch),
+         "score": float(net.score())})
     return 0
 
 

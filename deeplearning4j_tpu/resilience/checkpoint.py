@@ -45,6 +45,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
+from ..utils.fileio import atomic_write, atomic_write_bytes
 from ..utils.model_serializer import (COEFFICIENTS_BIN, CONFIG_JSON,
                                       MANIFEST_JSON, STATE_BIN, UPDATER_BIN,
                                       ModelSerializationError, _flatten_state,
@@ -82,7 +84,7 @@ class CheckpointCorruptError(ModelSerializationError):
 
 # Process-wide status the /healthz endpoint reports: the most recent
 # durable write and the state this process resumed from (if any).
-_status_lock = threading.Lock()
+_status_lock = make_lock("resilience.checkpoint.status")
 _last_write: Optional[Dict[str, Any]] = None
 _resumed_from: Optional[Dict[str, Any]] = None
 
@@ -307,7 +309,6 @@ def write_snapshot(snap: Dict[str, Any], path: str) -> None:
     """Serialize ``snap`` atomically to ``path``: temp file in the same
     directory -> fsync -> ``os.replace`` -> directory fsync.  Any
     interruption leaves either the old file or the new one."""
-    directory = os.path.dirname(os.path.abspath(path)) or "."
     resume = snap["resume"]
     payload: List[Tuple[str, bytes]] = [
         (CONFIG_JSON, snap["config"].encode("utf-8")),
@@ -330,32 +331,11 @@ def write_snapshot(snap: Dict[str, Any], path: str) -> None:
         "entries": {name: {"sha256": _sha256(data), "size": len(data)}
                     for name, data in payload},
     }
-    tmp = os.path.join(
-        directory,
-        f".tmp-{os.path.basename(path)}.{os.getpid()}")
-    try:
-        with open(tmp, "wb") as fh:
-            with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
-                for name, data in payload:
-                    zf.writestr(name, data)
-                zf.writestr(MANIFEST_JSON, json.dumps(manifest, indent=2))
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-    try:
-        dfd = os.open(directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass
+    with atomic_write(path, "wb") as fh:
+        with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+            for name, data in payload:
+                zf.writestr(name, data)
+            zf.writestr(MANIFEST_JSON, json.dumps(manifest, indent=2))
 
 
 def restore(net, path: str) -> ResumeState:
@@ -434,7 +414,7 @@ class CheckpointManager:
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=2)
         self._writer: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
-        self._error_lock = threading.Lock()
+        self._error_lock = make_lock("resilience.checkpoint.error")
 
     # ---- cadence ---------------------------------------------------------
     def note_steps(self, n: int) -> None:
@@ -733,30 +713,9 @@ def list_pod_checkpoints(directory: str) -> List[str]:
     return [p for _, p in sorted(out, reverse=True)]
 
 
-def _atomic_write_bytes(path: str, data: bytes) -> None:
-    directory = os.path.dirname(os.path.abspath(path)) or "."
-    tmp = os.path.join(directory,
-                       f".tmp-{os.path.basename(path)}.{os.getpid()}")
-    try:
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-    try:
-        dfd = os.open(directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass
+# re-exported for deploy/store.py and pod-shard writers; the
+# implementation now lives with the rest of the crash-safe IO
+_atomic_write_bytes = atomic_write_bytes
 
 
 def _leaf_shards(leaf):

@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..datasets.dataset import DataSet
+from ..monitor.locks import make_lock
 from ..resilience import faults as _faults
 from . import compression as _compression
 
@@ -82,8 +83,9 @@ class ParameterServer:
         self.chunk_size = int(chunk_size or DEFAULT_CHUNK_SIZE)
         self.bounds = _compression.chunk_bounds(self._flat.size,
                                                 self.chunk_size)
-        self._locks = [threading.Lock() for _ in self.bounds]
-        self._meta = threading.Lock()
+        self._locks = [make_lock("scaleout.server.chunk")
+                       for _ in self.bounds]
+        self._meta = make_lock("scaleout.server.meta")
         self.pushes = 0
         self.version = 0
 
@@ -275,7 +277,7 @@ class TcpParameterServer:
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("scaleout.tcp.dedup")
         # keys: (req_id, -1) for whole raw pushes, (req_id, chunk_idx)
         # for streamed chunk records
         self._seen: "collections.OrderedDict[Tuple[int, int], None]" = \
@@ -611,7 +613,12 @@ class TcpParameterServerClient:
         self.backoff_max = float(backoff_max)
         self._conn: Optional[socket.socket] = None
         self._ever_connected = False
-        self._lock = threading.Lock()
+        # two locks, never nested the other way around: the io lock
+        # serializes whole wire round trips (taken inside _request
+        # only); the state lock covers residual/version mutation and is
+        # never held across socket I/O (lint rule R3)
+        self._io_lock = make_lock("scaleout.client.io")
+        self._lock = make_lock("scaleout.client.state")
         rng = random.Random()
         self._jitter = rng.uniform
         # unique-per-client id stream; the random base keeps ids from
@@ -673,7 +680,7 @@ class TcpParameterServerClient:
 
     def _request(self, op: bytes, payload: bytes, req_id: int,
                  ctx=None, coded: bool = False) -> bytes:
-        """One framed request with bounded retry; caller holds the
+        """One framed request with bounded retry, serialized on the io
         lock.  Transport failures anywhere in the round trip tear the
         socket down and retry the SAME frame (same ``req_id`` — the
         server dedups pushes whose first attempt landed).  With ``ctx``
@@ -682,6 +689,12 @@ class TcpParameterServerClient:
         the caller's trace even across a reconnect.  ``coded`` requests
         are preceded by a ``C`` negotiation on any not-yet-negotiated
         connection."""
+        with self._io_lock:
+            # dl4j-lint: disable=R3 the socket IS the shared state here: one connection carries one round trip at a time, and the retry/backoff loop must be exclusive so interleaved frames from another thread cannot corrupt request/response pairing; one client per worker thread keeps this uncontended
+            return self._request_locked(op, payload, req_id, ctx, coded)
+
+    def _request_locked(self, op: bytes, payload: bytes, req_id: int,
+                        ctx, coded: bool) -> bytes:
         last: Optional[Exception] = None
         for attempt in range(self.max_retries + 1):
             try:
@@ -731,19 +744,17 @@ class TcpParameterServerClient:
             f"{last}") from last
 
     def pull(self) -> np.ndarray:
-        with self._lock:
-            with _monitor.span("param_server_client/pull"):
-                body = self._request(b"P", b"", next(self._req_ids),
-                                     ctx=_monitor.current_context())
-            return np.frombuffer(body, np.float64).copy()
+        with _monitor.span("param_server_client/pull"):
+            body = self._request(b"P", b"", next(self._req_ids),
+                                 ctx=_monitor.current_context())
+        return np.frombuffer(body, np.float64).copy()
 
     def push(self, delta: np.ndarray) -> None:
         data = np.asarray(delta, np.float64).tobytes()
-        with self._lock:
-            with _monitor.span("param_server_client/push",
-                               nbytes=len(data)):
-                self._request(b"U", data, next(self._req_ids),
-                              ctx=_monitor.current_context())
+        with _monitor.span("param_server_client/push",
+                           nbytes=len(data)):
+            self._request(b"U", data, next(self._req_ids),
+                          ctx=_monitor.current_context())
 
     # -- compressed/coded surface ---------------------------------------
 
@@ -753,7 +764,8 @@ class TcpParameterServerClient:
         if self.codec_id is None or self.chunk_size is None:
             body = self._request(b"V", b"", next(self._req_ids),
                                  coded=True)
-            (self.server_version,) = struct.unpack(">Q", body)
+            with self._lock:
+                (self.server_version,) = struct.unpack(">Q", body)
 
     def push_delta(self, delta: np.ndarray) -> int:
         """Compressed, error-fed push.  Encodes ``delta + residual``
@@ -764,54 +776,58 @@ class TcpParameterServerClient:
         server's per-chunk dedup and this client's residual stay
         consistent under at-least-once delivery."""
         flat = np.asarray(delta, np.float64).reshape(-1)
+        self._ensure_negotiated()
         with self._lock:
-            self._ensure_negotiated()
+            # residual mutation only — the wire round trip happens
+            # outside so a slow server never stalls other state readers
             if self._ef is None or self._ef.residual.size != flat.size:
                 self._ef = _compression.ErrorFeedback(
                     flat.size, self.codec_id, self.chunk_size,
                     self.topk_fraction)
             payload = _compression.pack_records(self._ef.encode(flat))
-            with _monitor.span(
-                    "param_server_client/push",
-                    nbytes=len(payload),
-                    codec=_compression.CODEC_NAMES[self.codec_id]):
-                body = self._request(b"Z", payload,
-                                     next(self._req_ids),
-                                     ctx=_monitor.current_context(),
-                                     coded=True)
+        with _monitor.span(
+                "param_server_client/push",
+                nbytes=len(payload),
+                codec=_compression.CODEC_NAMES[self.codec_id]):
+            body = self._request(b"Z", payload,
+                                 next(self._req_ids),
+                                 ctx=_monitor.current_context(),
+                                 coded=True)
+        with self._lock:
             (self.server_version,) = struct.unpack(">Q", body)
-            self._wire_client("out", self.codec_id, len(payload))
-            return self.server_version
+            version = self.server_version
+        self._wire_client("out", self.codec_id, len(payload))
+        return version
 
     def pull_coded(self) -> np.ndarray:
         """Full parameter snapshot under the dense variant of the
         negotiated codec; synchronizes :meth:`staleness` to zero."""
+        self._ensure_negotiated()
+        with _monitor.span(
+                "param_server_client/pull",
+                codec=_compression.CODEC_NAMES[self.codec_id]):
+            body = self._request(b"G", b"", next(self._req_ids),
+                                 ctx=_monitor.current_context(),
+                                 coded=True)
+        (version,) = struct.unpack(">Q", body[:8])
+        dense = _compression.dense_codec(self.codec_id)
+        bounds = None
+        if self.chunk_size:
+            # total dim is whatever the records cover; bounds are
+            # rebuilt once the payload names the last chunk
+            records = _compression.unpack_records(body[8:])
+            dim = 0
+            for idx, enc in records:
+                if dense == _compression.CODEC_F32:
+                    dim += len(enc) // 4
+                else:
+                    dim += len(enc) - 8   # int8: 8-byte affine head
+            bounds = _compression.chunk_bounds(dim, self.chunk_size)
+        params = _compression.decode_dense(dense, body[8:], bounds)
         with self._lock:
-            self._ensure_negotiated()
-            with _monitor.span(
-                    "param_server_client/pull",
-                    codec=_compression.CODEC_NAMES[self.codec_id]):
-                body = self._request(b"G", b"", next(self._req_ids),
-                                     ctx=_monitor.current_context(),
-                                     coded=True)
-            (version,) = struct.unpack(">Q", body[:8])
-            dense = _compression.dense_codec(self.codec_id)
-            bounds = None
-            if self.chunk_size:
-                # total dim is whatever the records cover; bounds are
-                # rebuilt once the payload names the last chunk
-                records = _compression.unpack_records(body[8:])
-                dim = 0
-                for idx, enc in records:
-                    if dense == _compression.CODEC_F32:
-                        dim += len(enc) // 4
-                    else:
-                        dim += len(enc) - 8   # int8: 8-byte affine head
-                bounds = _compression.chunk_bounds(dim, self.chunk_size)
-            params = _compression.decode_dense(dense, body[8:], bounds)
             self.server_version = self.local_version = version
-            self._wire_client("in", dense, len(body))
-            return params
+        self._wire_client("in", dense, len(body))
+        return params
 
     def staleness(self) -> int:
         """Server versions elapsed since this client's last coded pull
@@ -820,12 +836,12 @@ class TcpParameterServerClient:
 
     def version(self) -> int:
         """The server's current version counter (``V`` probe)."""
+        body = self._request(b"V", b"", next(self._req_ids),
+                             coded=self._cap_mask is not None)
+        (v,) = struct.unpack(">Q", body)
         with self._lock:
-            body = self._request(b"V", b"", next(self._req_ids),
-                                 coded=self._cap_mask is not None)
-            (v,) = struct.unpack(">Q", body)
             self.server_version = v
-            return v
+        return v
 
     @staticmethod
     def _wire_client(direction: str, codec_id: int, nbytes: int) -> None:
@@ -839,16 +855,14 @@ class TcpParameterServerClient:
         """The server process's span ring: ``{"pid": int, "events":
         [...]}`` — merge with the local tracer's events to render one
         cross-process timeline."""
-        with self._lock:
-            body = self._request(b"D", b"", next(self._req_ids))
+        body = self._request(b"D", b"", next(self._req_ids))
         return json.loads(body.decode("utf-8"))
 
     @property
     def pushes(self) -> int:
-        with self._lock:
-            body = self._request(b"S", b"", next(self._req_ids))
-            (n,) = struct.unpack(">Q", body)
-            return n
+        body = self._request(b"S", b"", next(self._req_ids))
+        (n,) = struct.unpack(">Q", body)
+        return n
 
     def close(self) -> None:
         if self._conn is not None:

@@ -83,6 +83,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
 from .admission import SloAdmissionController
 from .bucketing import BucketPolicy, assemble_batch
 
@@ -244,8 +245,8 @@ class InferenceEngine:
         self._session_pins: dict = {}    # retired version -> host tree
         self._route_counter = itertools.count()
         self._placed: dict = {}          # (worker_idx, version) -> placed
-        self._placed_lock = threading.Lock()
-        self._compile_lock = threading.Lock()
+        self._placed_lock = make_lock("serving.engine.placed")
+        self._compile_lock = make_lock("serving.engine.compile")
         self._running = False
         self._threads: List[threading.Thread] = []
         self._admission = (SloAdmissionController(slo_p99_ms)
@@ -253,7 +254,7 @@ class InferenceEngine:
         self._sessions = None
         self._session_opts = {"ttl_s": float(session_ttl_s),
                               "max_sessions": int(max_sessions)}
-        self._session_lock = threading.Lock()
+        self._session_lock = make_lock("serving.engine.session")
         # completion timestamps for the queue drain rate (Retry-After)
         self._done_times: "deque" = deque(maxlen=512)
         from .quantize import tree_nbytes

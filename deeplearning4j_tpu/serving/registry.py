@@ -38,6 +38,7 @@ from collections import OrderedDict
 from typing import List, Optional
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
 from .engine import InferenceEngine, ServingError
 
 
@@ -74,7 +75,7 @@ class ModelRegistry:
             raise ValueError("hbm_budget_bytes must be positive or None")
         self._budget = hbm_budget_bytes
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = make_lock("serving.registry", rlock=True)
 
     # ----------------------------------------------------------- hosting
     def register(self, name: str, engine: InferenceEngine, *,

@@ -53,6 +53,7 @@ from typing import Optional
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
 
 
 class SessionError(RuntimeError):
@@ -70,7 +71,7 @@ class _Session:
         self.carries = carries
         self.batch = batch
         self.last_used = time.monotonic()
-        self.lock = threading.Lock()
+        self.lock = make_lock("serving.session")
         self.steps = 0
         self.version = version
 
@@ -98,7 +99,7 @@ class SessionCache:
             raise ValueError("max_sessions must be >= 1")
         self._name = str(name)
         self._sessions: "OrderedDict[str, _Session]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.sessions.cache")
         # deployment hooks (set by InferenceEngine): version_fn() is the
         # engine's active weight version at session creation; weights_fn(v)
         # resolves the pinned version's host tree (None = live weights)

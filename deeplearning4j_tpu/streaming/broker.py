@@ -47,6 +47,8 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from .. import monitor as _monitor
+from ..monitor.locks import make_lock
+from ..utils.fileio import atomic_write_json
 from .sources import RecordSource
 
 _MAGIC_LEN = struct.Struct(">I")
@@ -77,6 +79,12 @@ def _recv_msg(sock: socket.socket) -> dict:
     return json.loads(_recv_exact(sock, n).decode("utf-8"))
 
 
+def _roundtrip(sock: socket.socket, req: dict) -> dict:
+    """One request/response pair; callers serialize per-socket."""
+    _send_msg(sock, req)
+    return _recv_msg(sock)
+
+
 # --------------------------------------------------------------- broker
 
 
@@ -97,7 +105,7 @@ class StreamBroker:
                  log_dir: Optional[str] = None,
                  session_timeout: float = 10.0,
                  max_records_per_partition: Optional[int] = None):
-        self._lock = threading.RLock()
+        self._lock = make_lock("streaming.broker.state", rlock=True)
         # (topic, partition) -> list of str records
         self._logs: Dict[Tuple[str, int], List[str]] = {}
         # (topic, partition) -> logical offset of the first retained
@@ -183,10 +191,9 @@ class StreamBroker:
     def _persist_offsets(self) -> None:
         if not self._log_dir:
             return
-        tmp = self._offsets_path() + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self._offsets, fh)
-        os.replace(tmp, self._offsets_path())
+        # atomic+fsync: committed offsets are the broker's recovery
+        # truth — a torn snapshot would rewind or skip every group
+        atomic_write_json(self._offsets_path(), self._offsets)
 
     # ---- topic / log ops ------------------------------------------------
     def create_topic(self, topic: str, partitions: int = 1) -> None:
@@ -421,15 +428,15 @@ class _BrokerConnection:
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
-        self._lock = threading.Lock()
+        self._lock = make_lock("streaming.conn")
 
     def call(self, req: dict) -> dict:
         ctx = _monitor.current_context()
         if ctx is not None:
             req = dict(req, _traceparent=ctx.traceparent())
         with self._lock:
-            _send_msg(self._sock, req)
-            resp = _recv_msg(self._sock)
+            # dl4j-lint: disable=R3 the socket IS the shared state: this lock exists solely to keep one request/response pair exclusive on the wire; there is no other state behind it to narrow the lock to
+            resp = _roundtrip(self._sock, req)
         if "error" in resp:
             raise RuntimeError(f"broker error: {resp['error']}")
         return resp

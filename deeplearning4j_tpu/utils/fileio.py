@@ -1,0 +1,101 @@
+"""Crash-safe file writes: the ONE temp+fsync+rename implementation.
+
+Every file that must survive a SIGKILL mid-write — checkpoints, deploy
+weight snapshots, early-stopping models, flight-recorder bundles, broker
+offset snapshots — goes through :func:`atomic_write` (or one of the
+convenience wrappers below).  The contract: after a crash at ANY point,
+the destination path holds either the complete old content or the
+complete new content, never a torn hybrid.  Achieved the standard way:
+
+1. write to a uniquely-named temp file **in the destination directory**
+   (``os.replace`` is only atomic within one filesystem);
+2. flush + ``os.fsync`` the temp file (data durable before the rename
+   can publish it);
+3. ``os.replace`` over the destination (atomic on POSIX);
+4. best-effort ``fsync`` of the directory (the rename itself durable).
+
+This module is the enforcement point for the R2 *atomic writes* rule in
+``tools/analyze/lint.py``: a bare ``open(path, "w")`` in the scoped
+packages is a lint finding; the fix is to route it here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+from typing import Any, Iterator, Optional
+
+
+def _fsync_dir(directory: str) -> None:
+    """Best-effort directory fsync so the rename is durable (skipped on
+    platforms/filesystems that refuse O_RDONLY directory handles)."""
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb",
+                 encoding: Optional[str] = None) -> Iterator[Any]:
+    """Context manager yielding a file object whose contents replace
+    ``path`` atomically on clean exit (and leave ``path`` untouched on
+    an exception or a crash).
+
+    >>> with atomic_write("/data/model.zip") as fh:
+    ...     zipfile.ZipFile(fh, "w").writestr("a", b"...")
+
+    ``mode`` must be a write mode (``"wb"`` default, ``"w"`` for text;
+    pass ``encoding`` for text).  The temp file lives next to the
+    destination (same filesystem) with a ``.tmp-`` hidden prefix so
+    directory listings keyed on real names never see it.
+    """
+    if "w" not in mode:
+        raise ValueError(f"atomic_write needs a write mode, got {mode!r}")
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=f".tmp-{os.path.basename(path)}.")
+    fh = None
+    try:
+        fh = os.fdopen(fd, mode, encoding=encoding)
+        yield fh
+        fh.flush()
+        os.fsync(fh.fileno())
+        fh.close()
+        os.replace(tmp, path)
+        _fsync_dir(directory)
+    finally:
+        if fh is not None and not fh.closed:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Atomically replace ``path`` with ``data``."""
+    with atomic_write(path, "wb") as fh:
+        fh.write(data)
+
+
+def atomic_write_text(path: str, text: str,
+                      encoding: str = "utf-8") -> None:
+    """Atomically replace ``path`` with ``text``."""
+    with atomic_write(path, "w", encoding=encoding) as fh:
+        fh.write(text)
+
+
+def atomic_write_json(path: str, obj: Any, **json_kwargs) -> None:
+    """Atomically replace ``path`` with ``json.dumps(obj)``."""
+    atomic_write_text(path, json.dumps(obj, **json_kwargs))

@@ -18,10 +18,13 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+import os
 import zipfile
 from typing import Optional
 
 import numpy as np
+
+from .fileio import atomic_write
 
 CONFIG_JSON = "configuration.json"
 COEFFICIENTS_BIN = "coefficients.bin"
@@ -69,10 +72,20 @@ def write_model(net, path: str, save_updater: bool = True) -> None:
         "state": state_manifest,
         "entries": _entry_digests(payload),
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        for name, data in payload:
-            zf.writestr(name, data)
-        zf.writestr(MANIFEST_JSON, json.dumps(manifest, indent=2))
+    def _write_zip(fh) -> None:
+        with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+            for name, data in payload:
+                zf.writestr(name, data)
+            zf.writestr(MANIFEST_JSON, json.dumps(manifest, indent=2))
+
+    if isinstance(path, (str, os.PathLike)):
+        # atomic: callers (early stopping, checkpoint listeners, user
+        # code) treat an existing model zip as restorable; a crash
+        # mid-write must leave the previous zip, not a torn one
+        with atomic_write(os.fspath(path), "wb") as fh:
+            _write_zip(fh)
+    else:
+        _write_zip(path)     # file-like (e.g. BytesIO): caller owns it
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = True):

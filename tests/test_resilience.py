@@ -271,7 +271,9 @@ def test_serializer_rejects_non_zip(tmp_path):
 def test_local_file_saver_interrupt_leaves_old_model(tmp_path,
                                                     monkeypatch):
     """Regression: a crash mid-save must never tear bestModel.bin —
-    the previous valid model must survive."""
+    the previous valid model must survive.  The atomicity lives inside
+    ``write_model`` (utils.fileio.atomic_write), so the simulated crash
+    tears the zip serialization itself, after partial bytes hit disk."""
     from deeplearning4j_tpu.earlystopping import savers as savers_mod
 
     net = chaos.build_net()
@@ -280,13 +282,17 @@ def test_local_file_saver_interrupt_leaves_old_model(tmp_path,
     final = os.path.join(str(tmp_path), "bestModel.bin")
     before = open(final, "rb").read()
 
-    def _boom(net_, path, save_updater=True):
-        with open(path, "wb") as fh:
-            fh.write(b"half a zi")       # torn partial write
+    import deeplearning4j_tpu.utils.model_serializer as ms
+
+    real_zipfile = ms.zipfile.ZipFile
+
+    def _boom(fh, mode="r", *args, **kwargs):
+        if "w" not in mode:              # reads go through untouched
+            return real_zipfile(fh, mode, *args, **kwargs)
+        fh.write(b"half a zi")           # torn partial write
         raise KeyboardInterrupt("interrupted mid-serialization")
 
-    import deeplearning4j_tpu.utils.model_serializer as ms
-    monkeypatch.setattr(ms, "write_model", _boom)
+    monkeypatch.setattr(ms.zipfile, "ZipFile", _boom)
     with pytest.raises(KeyboardInterrupt):
         saver.save_best_model(net, 0.1)
     assert open(final, "rb").read() == before     # untouched

@@ -1,0 +1,21 @@
+"""Project-native correctness tooling: invariant linter + lock-order
+race detector.
+
+- ``tools.analyze.lint`` — AST rules R1 (traced purity), R2 (atomic
+  writes), R3 (blocking under lock), R4 (registry drift), R5 (donation
+  safety), with audited inline suppressions.
+- ``tools.analyze.lockgraph`` — runtime lock-order cycle detector,
+  armed by ``DL4J_TPU_LOCK_DEBUG=1``.
+
+CI gate: ``python -m tools.analyze --strict`` (zero findings).  See
+``docs/ANALYSIS.md``.
+"""
+
+from tools.analyze.lint import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    check_registry,
+    lint_file,
+    lint_source,
+    run,
+)

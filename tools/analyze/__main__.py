@@ -1,0 +1,56 @@
+"""CLI for the invariant linter: ``python -m tools.analyze``.
+
+Exit status 0 when no findings survive suppression; ``--strict`` (the
+CI mode) is the same check with the contract spelled out in the name.
+``--write-registry`` regenerates the env/metric inventory block in
+``docs/OBSERVABILITY.md`` instead of failing R4 on drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.analyze import lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="project-native invariant linter (rules R1-R5)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit nonzero on any finding (CI gate)")
+    parser.add_argument("--write-registry", action="store_true",
+                        help="regenerate the docs/OBSERVABILITY.md "
+                             "env/metric inventory block")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--rules", default=",".join(lint.ALL_RULES),
+                        help="comma-separated rule subset "
+                             "(default: all)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetect from this "
+                             "file's location)")
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+    findings = lint.run(root, rules=rules,
+                        write_registry=args.write_registry)
+
+    if args.json:
+        print(json.dumps([{
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "message": f.message} for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
