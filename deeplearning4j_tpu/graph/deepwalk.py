@@ -409,7 +409,8 @@ class DeepWalk(GraphVectors):
             jnp.asarray(pmask.reshape(n_chunks, B)),
             self._points_dev, self._codes_dev, self._cmask_dev,
             jnp.float32(self.learning_rate))
-        self._cum_loss += float(np.asarray(loss))
+        # dl4j-lint: disable=R7 one fetch per walk batch: the monitored
+        self._cum_loss += float(np.asarray(loss))  # loss + batch barrier
 
     # -- GraphVectors surface ---------------------------------------------
 

@@ -30,6 +30,7 @@ through :func:`registry`/:func:`tracer` at call time, so :func:`reset`
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Dict, Optional
 
@@ -53,10 +54,40 @@ __all__ = [
     "health_snapshot", "histogram", "incident_dir", "new_trace_id",
     "observe_phase", "parse_traceparent", "phase_breakdown",
     "post_system_metrics", "prometheus_text", "publish_cost_analysis",
-    "record_incident", "registry", "reset", "snapshot", "span",
+    "record_incident", "registry", "reset", "sanitize_end_warmup",
+    "sanitize_scenario", "snapshot", "span",
     "system_metrics_persistable", "trace_chrome_json", "trace_jsonl",
     "tracer", "watched_jit",
 ]
+
+
+def _sanitizer_mod():
+    """``tools.analyze.sanitizer`` when importable AND armed, else
+    ``None`` — so fit/serving call sites stay no-ops in stripped
+    deployments and unarmed processes (mirrors ``locks.make_lock``)."""
+    try:
+        from tools.analyze import sanitizer as _san
+    except Exception:
+        return None
+    return _san if _san.enabled() else None
+
+
+def sanitize_scenario(name: str, units: int = 1, extra: int = 0):
+    """Bracket one unit of dispatch-budgeted work (one fused fit epoch
+    group, one serving RNN step) for the runtime sanitizer; a null
+    context unless ``DL4J_TPU_SANITIZE=1``."""
+    san = _sanitizer_mod()
+    if san is None:
+        return contextlib.nullcontext()
+    return san.scenario(name, units=units, extra=extra)
+
+
+def sanitize_end_warmup() -> None:
+    """Tell the armed sanitizer warmup is over: from here on any
+    recompile is a contract violation."""
+    san = _sanitizer_mod()
+    if san is not None:
+        san.end_warmup()
 
 # Canonical phase-histogram names: host wall-clock attribution of one
 # training loop.  "data" = host-side batch prep + transfer staging,

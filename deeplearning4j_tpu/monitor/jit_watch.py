@@ -44,6 +44,26 @@ XLA_FLOPS = "xla_cost_flops"
 XLA_BYTES = "xla_cost_bytes_accessed"
 XLA_PEAK_HBM = "xla_cost_peak_hbm_bytes"
 
+_SAN = None
+_SAN_TRIED = False
+
+
+def _sanitizer():
+    """The runtime dispatch sanitizer, or ``None`` when the tools
+    package is absent (stripped deployments).  The import is attempted
+    once and cached; the armed check stays a cheap env read so an
+    unarmed process pays one ``dict.get`` per dispatch."""
+    global _SAN, _SAN_TRIED
+    if not _SAN_TRIED:
+        _SAN_TRIED = True
+        try:
+            from tools.analyze import sanitizer as _mod
+            _SAN = _mod
+        except Exception:
+            _SAN = None
+    return _SAN
+
+
 _HELP = {
     COMPILES_TOTAL: "jitted-function compilations (first call per "
                     "abstract signature)",
@@ -178,24 +198,55 @@ class WatchedJit:
         self._fn = fn
         self.name = name or getattr(fn, "__name__", "jit_fn")
         self._static_argnums = tuple(static_argnums or ())
+        self._donate_argnums = tuple(donate_argnums or ())
         jit_kw = dict(jit_kwargs)
         if self._static_argnums:
             jit_kw["static_argnums"] = self._static_argnums
-        if donate_argnums:
-            jit_kw["donate_argnums"] = tuple(donate_argnums)
+        if self._donate_argnums:
+            jit_kw["donate_argnums"] = self._donate_argnums
         self._jitted = jax.jit(fn, **jit_kw)
         self._seen: Set[str] = set()
         self.__wrapped__ = fn
 
+    def _dispatch(self, args, kwargs, san):
+        """The actual jitted call; when the sanitizer is armed and this
+        function donates, verify each donated input buffer actually
+        reports deleted afterwards (jax skips unusable donation with no
+        warning — the silent HBM regression the audit exists for)."""
+        if san is None or not self._donate_argnums \
+                or not san.donation_audit():
+            return self._jitted(*args, **kwargs)
+        donated = []
+        for pos in self._donate_argnums:
+            if pos < len(args):
+                donated.extend(
+                    leaf for leaf in jax.tree_util.tree_leaves(args[pos])
+                    if isinstance(leaf, jax.Array))
+        out = self._jitted(*args, **kwargs)
+        if donated:
+            missed = sum(1 for leaf in donated if not leaf.is_deleted())
+            san.record_donation(self.name, missed=missed,
+                                total=len(donated))
+        return out
+
     def __call__(self, *args, **kwargs):
         signature = abstract_signature(args, kwargs, self._static_argnums)
         reg = registry()
+        san = _sanitizer()
+        if san is not None and not san.enabled():
+            san = None
         if signature in self._seen:
             reg.counter(CACHE_HITS_TOTAL, _HELP[CACHE_HITS_TOTAL]).inc(
                 fn=self.name)
-            return self._jitted(*args, **kwargs)
+            if san is not None:
+                san.record_dispatch(self.name, compiled=False,
+                                    recompile=False)
+            return self._dispatch(args, kwargs, san)
         recompile = bool(self._seen)
         self._seen.add(signature)
+        if san is not None:
+            san.record_dispatch(self.name, compiled=True,
+                                recompile=recompile)
         if not recompile:
             # Cost gauges for the first signature only: .lower() traces
             # without compiling or consuming donated buffers, and one
@@ -208,7 +259,7 @@ class WatchedJit:
         t0 = time.perf_counter()
         with tracer().span(f"jit/compile/{self.name}",
                            signature=signature, recompile=recompile):
-            out = self._jitted(*args, **kwargs)
+            out = self._dispatch(args, kwargs, san)
         elapsed = time.perf_counter() - t0
         reg.counter(COMPILES_TOTAL, _HELP[COMPILES_TOTAL]).inc(fn=self.name)
         reg.histogram(COMPILE_MS, _HELP[COMPILE_MS]).observe(

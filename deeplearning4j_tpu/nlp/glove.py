@@ -304,6 +304,7 @@ class Glove(SequenceVectors):
         #: monitored loss: the FINAL epoch's weighted-least-squares sum
         #: (the reference logs per-epoch GloVe loss); fetching it is also
         #: the fit's device completion barrier
+        # dl4j-lint: disable=R7 one fetch per fit: logged loss doubles as the completion barrier
         self.last_epoch_loss = (float(np.asarray(ep_loss))
                                 if self.epochs else None)
 

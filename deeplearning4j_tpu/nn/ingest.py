@@ -39,6 +39,7 @@ compromise as ``fit_scan``).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import List, Optional, Tuple
@@ -447,40 +448,51 @@ def run_device_cached_fit(model, u, epochs: int, dispatch, *,
                 consume_epoch(u)
             _monitor.observe_phase("data", time.perf_counter() - t0)
             t1 = time.perf_counter()
-            if steps and (pos or step_cadence is not None):
-                # resumed and/or checkpointed epoch: chunked dispatches
-                # over [pos, steps), each chunk ending on a save point
-                while pos < steps:
-                    run = steps - pos
-                    if step_cadence is not None:
-                        run = min(run, ckpt.steps_to_next_save())
-                    scores = dispatch(model.epoch, 1, 0, pos, run)
+            chunked = bool(steps and (pos or step_cadence is not None))
+            # Clean fused path: the sanitizer's budgeted unit is one
+            # dispatch per fused epoch plus one for the tail batch.
+            # Resumed/checkpointed epochs legitimately chunk into
+            # multiple dispatches, so only the clean path is bracketed.
+            scen = (contextlib.nullcontext() if chunked else
+                    _monitor.sanitize_scenario("fit.epoch_cache",
+                                               units=fuse,
+                                               extra=1 if tail else 0))
+            with scen:
+                if chunked:
+                    # resumed and/or checkpointed epoch: chunked
+                    # dispatches over [pos, steps), each chunk ending
+                    # on a save point
+                    while pos < steps:
+                        run = steps - pos
+                        if step_cadence is not None:
+                            run = min(run, ckpt.steps_to_next_save())
+                        scores = dispatch(model.epoch, 1, 0, pos, run)
+                        replay.add(model.iteration, scores)
+                        iters.inc(run)
+                        model.iteration += run
+                        model.last_batch_size = batch
+                        pos += run
+                        if ckpt is not None:
+                            ckpt.note_steps(run)
+                        if pos < steps:
+                            maybe_save(pos)
+                            _faults.maybe_die(model.iteration)
+                elif steps:
+                    scores = dispatch(model.epoch, fuse, 0, 0, steps)
                     replay.add(model.iteration, scores)
-                    iters.inc(run)
-                    model.iteration += run
+                    iters.inc(fuse * steps)
+                    model.iteration += fuse * steps
                     model.last_batch_size = batch
-                    pos += run
                     if ckpt is not None:
-                        ckpt.note_steps(run)
-                    if pos < steps:
-                        maybe_save(pos)
-                        _faults.maybe_die(model.iteration)
-            elif steps:
-                scores = dispatch(model.epoch, fuse, 0, 0, steps)
-                replay.add(model.iteration, scores)
-                iters.inc(fuse * steps)
-                model.iteration += fuse * steps
-                model.last_batch_size = batch
-                if ckpt is not None:
-                    ckpt.note_steps(fuse * steps)
-            if tail:
-                scores = dispatch(model.epoch, 1, tail, 0, 0)
-                replay.add(model.iteration, scores)
-                iters.inc(1)
-                model.iteration += 1
-                model.last_batch_size = tail
-                if ckpt is not None:
-                    ckpt.note_steps(1)
+                        ckpt.note_steps(fuse * steps)
+                if tail:
+                    scores = dispatch(model.epoch, 1, tail, 0, 0)
+                    replay.add(model.iteration, scores)
+                    iters.inc(1)
+                    model.iteration += 1
+                    model.last_batch_size = tail
+                    if ckpt is not None:
+                        ckpt.note_steps(1)
             _monitor.observe_phase("step", time.perf_counter() - t1)
             if model.listeners:
                 t2 = time.perf_counter()

@@ -394,6 +394,7 @@ class MeshRuntime:
                            ("all_gather",
                             lambda v, a=axis: lax.all_gather(
                                 v, a, tiled=True))):
+                # dl4j-lint: disable=R6 one program per (axis, op) pair by design, compiled outside the timed region
                 prog = jax.jit(_shard_map(
                     fn, mesh=self.mesh, in_specs=P(axis),
                     out_specs=P()))

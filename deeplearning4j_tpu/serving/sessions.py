@@ -168,13 +168,15 @@ class SessionCache:
                 if w is not None:
                     kw = {"params": w[0], "net_state": w[1]}
             # ONE dispatch: explicit-carry step, carries stay on device
-            if self._is_graph:
-                outs, sess.carries = self._model.rnn_stateless_step(
-                    sess.carries, *arrays, **kw)
-                out = outs[0] if len(outs) == 1 else outs
-            else:
-                out, sess.carries = self._model.rnn_stateless_step(
-                    sess.carries, x, **kw)
+            # (the budgeted contract the armed sanitizer asserts)
+            with _monitor.sanitize_scenario("serving.rnn_step"):
+                if self._is_graph:
+                    outs, sess.carries = self._model.rnn_stateless_step(
+                        sess.carries, *arrays, **kw)
+                    out = outs[0] if len(outs) == 1 else outs
+                else:
+                    out, sess.carries = self._model.rnn_stateless_step(
+                        sess.carries, x, **kw)
             sess.steps += 1
             sess.last_used = time.monotonic()
         _monitor.counter("serving_session_steps_total",

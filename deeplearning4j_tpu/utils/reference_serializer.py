@@ -504,7 +504,7 @@ def read_reference_model(path, load_updater: bool = True):
     offset = 0
     for i, layer in enumerate(net.layers):
         for pname in layer.param_order():
-            shape = np.asarray(net.params[i][pname]).shape
+            shape = tuple(net.params[i][pname].shape)
             n = int(np.prod(shape))
             seg = flat[offset:offset + n]
             if seg.size != n:
@@ -533,7 +533,7 @@ def read_reference_model(path, load_updater: bool = True):
             if not slots:
                 continue
             for pname in layer.param_order():
-                shape = np.asarray(net.params[i][pname]).shape
+                shape = tuple(net.params[i][pname].shape)
                 n = int(np.prod(shape))
                 for slot in slots:
                     seg = state_flat[offset:offset + n]

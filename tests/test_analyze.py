@@ -419,6 +419,306 @@ def test_factory_is_plain_lock_when_disabled(monkeypatch):
     assert lock.name == "t.on"
 
 
+# ------------------------------------------------- R6: retrace risk
+
+R6_HOT = '''
+import jax
+
+step = jax.jit(_step, static_argnums=(1,))
+
+def once(x):
+    return jax.jit(lambda v: v * 2)(x)      # construct-and-call
+
+def per_batch(fns, x):
+    for f in fns:
+        g = jax.jit(f)                      # factory in loop body
+        x = g(x)
+    return x
+
+def bad_static(x):
+    return step(x, [1, 2, 3])               # non-hashable static arg
+
+def sweep(x, widths):
+    out = []
+    for w in widths:
+        out.append(step(x, w))              # loop-var static arg
+    return out
+
+SCALES = {}
+
+def set_scale(k, v):
+    SCALES[k] = v
+
+@jax.jit
+def scaled(x):
+    return x * SCALES["w"]                  # traced closure over mutated
+'''
+
+
+def test_r6_trips_on_all_retrace_shapes():
+    fs = lint_source(R6_HOT, "fx.py", rules={"R6"})
+    assert _rules(fs) == ["R6"]
+    msgs = " ".join(f.message for f in fs)
+    assert "constructs and invokes" in msgs
+    assert "inside a loop body" in msgs
+    assert "non-hashable literal" in msgs
+    assert "loop variable" in msgs
+    assert "module-level mutable" in msgs
+    assert len(fs) == 5
+
+
+R6_CLEAN = '''
+import jax
+
+step = jax.jit(_step, static_argnums=(1,))
+gather = jax.jit(lambda d, i: d[i])         # bound once at module scope
+
+def run(x, n):
+    return step(x, n)                       # hashable static from caller
+
+def loop(xs):
+    out = []
+    for x in xs:
+        out.append(gather(x, 0))            # reuse of the bound jit
+    return out
+
+WIDTHS = (4, 8)                             # immutable: trace-safe
+
+@jax.jit
+def scaled(x):
+    return x * WIDTHS[0]
+'''
+
+
+def test_r6_clean_corpus_silent():
+    assert lint_source(R6_CLEAN, "fx.py", rules={"R6"}) == []
+
+
+# --------------------------------------- R7: hidden host<->device
+
+R7_HOT = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+step = jax.jit(_step)
+
+def train_log(params, batch):
+    loss = step(params, batch)
+    return float(loss)                      # cast on a jit output
+
+def norms(w):
+    g = jnp.linalg.norm(w)
+    return np.asarray(g)                    # full device->host copy
+
+def flag(x):
+    m = jnp.max(x)
+    if bool(m):                             # blocking truthiness fetch
+        return 1
+    return 0
+
+def count(x):
+    return int(jnp.sum(x))                  # cast directly on jnp call
+'''
+
+
+def test_r7_trips_on_hidden_transfers():
+    fs = lint_source(R7_HOT, "fx.py", rules={"R7"})
+    assert _rules(fs) == ["R7"]
+    msgs = " ".join(f.message for f in fs)
+    assert "float(...)" in msgs
+    assert "np.asarray(...)" in msgs
+    assert "bool(...)" in msgs
+    assert "int(...)" in msgs
+    assert len(fs) == 4
+
+
+R7_CLEAN = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+step = jax.jit(_step)
+
+def meta(features):
+    x = jnp.asarray(features)
+    return int(x.shape[0])                  # metadata read: no transfer
+
+def host_math(a):
+    h = np.mean(a)                          # numpy stays on host
+    return float(h)
+
+def build():
+    return np.asarray([1, 2, 3])            # host literal
+
+@jax.jit
+def traced(x):
+    return x * 2                            # traced code is R1's domain
+'''
+
+
+def test_r7_clean_corpus_silent():
+    assert lint_source(R7_CLEAN, "fx.py", rules={"R7"}) == []
+
+
+# ----------------------------------- R8: lockset guarded-field drift
+
+R8_HOT = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0                         # __init__ writes are free
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0                         # bare write, guarded in bump
+
+
+class Table:
+    def grow(self):
+        with self._row_lock:
+            self._rows = []
+
+    def shrink(self):
+        with self._col_lock:
+            self._rows = None               # disjoint lock for same field
+
+
+class Registry:
+    def _set_locked(self, v):
+        self._val = v                       # guarded by *_locked convention
+
+    def clobber(self):
+        self._val = None                    # bare write
+'''
+
+
+def test_r8_trips_on_lockset_drift():
+    fs = lint_source(R8_HOT, "fx.py", rules={"R8"})
+    assert _rules(fs) == ["R8"]
+    msgs = " ".join(f.message for f in fs)
+    assert "Cache.reset" in msgs
+    assert "disjoint locks" in msgs
+    assert "Registry.clobber" in msgs
+    assert len(fs) == 3
+
+
+R8_CLEAN = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._hits = 0                      # written ONLY in __init__
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def drain(self):
+        with self._lock:
+            self._n = 0                     # same lock everywhere
+
+    def _fast_bump_locked(self):
+        self._n += 1                        # caller-holds-lock convention
+
+class Local:
+    def compute(self):
+        self._scratch = 1                   # never guarded anywhere
+        return self._scratch
+'''
+
+
+def test_r8_clean_corpus_silent():
+    assert lint_source(R8_CLEAN, "fx.py", rules={"R8"}) == []
+
+
+# ------------------------------- whole-program cross-module analysis
+
+def test_cross_module_traced_and_blocking_propagation(tmp_path):
+    """R1 reaches a helper in ANOTHER module through a jit root's
+    imported call, and R3's blocking fixpoint sees through an imported
+    socket helper."""
+    root = str(tmp_path)
+    _write(root, "deeplearning4j_tpu/__init__.py", "")
+    _write(root, "deeplearning4j_tpu/util.py", '''
+import time
+
+def helper(x):
+    return x * time.time()
+
+def recv_all(sock, n):
+    return sock.recv(n)
+''')
+    _write(root, "deeplearning4j_tpu/hot.py", '''
+import jax
+from deeplearning4j_tpu.util import helper, recv_all
+
+@jax.jit
+def step(x):
+    return helper(x)
+
+class Client:
+    def call(self):
+        with self._lock:
+            return recv_all(self._sock, 4)
+''')
+    fs = run(root, rules={"R1", "R3"})
+    by_rule = {f.rule: f for f in fs}
+    assert set(by_rule) == {"R1", "R3"}
+    assert by_rule["R1"].path.endswith("util.py")      # helper is traced
+    assert "time.time" in by_rule["R1"].message
+    assert by_rule["R3"].path.endswith("hot.py")       # via import
+    assert "recv_all" in by_rule["R3"].message
+
+
+def test_cross_module_class_methods_not_conflated(tmp_path):
+    """A self-call resolves against the caller's OWN class: a same-named
+    blocking method on an unrelated class must not leak in."""
+    root = str(tmp_path)
+    _write(root, "deeplearning4j_tpu/__init__.py", "")
+    _write(root, "deeplearning4j_tpu/pair.py", '''
+class Server:
+    def create(self):
+        return {}
+
+    def handle(self):
+        with self._lock:
+            return self.create()        # the LOCAL in-memory create
+
+class NetClient:
+    def create(self):
+        return self._sock.recv(4)       # blocking, but a different class
+''')
+    assert run(root, rules={"R3"}) == []
+
+
+# ------------------------------------------------ CLI exit codes
+
+def test_cli_exit_codes(tmp_path):
+    """0 = clean, 1 = findings, 2 = the analyzer itself failed — CI
+    distinguishes 'dirty code' from 'the gate did not run'."""
+    from tools.analyze.__main__ import main as analyze_main
+
+    clean = str(tmp_path / "clean")
+    _write(clean, "deeplearning4j_tpu/ok.py", "def f():\n    return 1\n")
+    assert analyze_main(["--root", clean, "--rules", "R6"]) == 0
+
+    dirty = str(tmp_path / "dirty")
+    _write(dirty, "deeplearning4j_tpu/bad.py",
+           "import jax\n\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n")
+    assert analyze_main(["--root", dirty, "--rules", "R6"]) == 1
+
+    assert analyze_main(
+        ["--root", str(tmp_path / "missing"), "--rules", "R6"]) == 2
+
+
 # ------------------------- satellite: real concurrent smoke is acyclic
 
 def test_serving_plus_param_server_smoke_stays_acyclic(monkeypatch):

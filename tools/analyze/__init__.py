@@ -1,11 +1,18 @@
-"""Project-native correctness tooling: invariant linter + lock-order
-race detector.
+"""Project-native correctness tooling: invariant linter + whole-program
+call graph + runtime sanitizers.
 
 - ``tools.analyze.lint`` — AST rules R1 (traced purity), R2 (atomic
   writes), R3 (blocking under lock), R4 (registry drift), R5 (donation
-  safety), with audited inline suppressions.
+  safety), R6 (retrace risk), R7 (hidden host<->device transfers), R8
+  (lockset guarded-field drift), with audited inline suppressions.
+- ``tools.analyze.callgraph`` — import-resolved cross-module call
+  graph; makes R1 reachability and R3's blocking fixpoint
+  whole-program.
 - ``tools.analyze.lockgraph`` — runtime lock-order cycle detector,
   armed by ``DL4J_TPU_LOCK_DEBUG=1``.
+- ``tools.analyze.sanitizer`` — runtime dispatch sanitizer (recompile /
+  dispatch-budget / donation contracts), armed by
+  ``DL4J_TPU_SANITIZE=1``.
 
 CI gate: ``python -m tools.analyze --strict`` (zero findings).  See
 ``docs/ANALYSIS.md``.

@@ -1,7 +1,14 @@
 """CLI for the invariant linter: ``python -m tools.analyze``.
 
-Exit status 0 when no findings survive suppression; ``--strict`` (the
-CI mode) is the same check with the contract spelled out in the name.
+Exit status contract (asserted by tests/test_analyze.py):
+
+- **0** — no findings survive suppression; ``--strict`` (the CI mode)
+  is the same check with the contract spelled out in the name.
+- **1** — at least one finding.
+- **2** — the analyzer itself failed (bad ``--root``, unreadable tree,
+  internal crash): CI must distinguish "the code is dirty" from "the
+  gate did not run".
+
 ``--write-registry`` regenerates the env/metric inventory block in
 ``docs/OBSERVABILITY.md`` instead of failing R4 on drift.
 """
@@ -12,14 +19,20 @@ import argparse
 import json
 import os
 import sys
+import traceback
 
 from tools.analyze import lint
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL_ERROR = 2
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.analyze",
-        description="project-native invariant linter (rules R1-R5)")
+        description="project-native whole-program invariant linter "
+                    "(rules R1-R8)")
     parser.add_argument("--strict", action="store_true",
                         help="exit nonzero on any finding (CI gate)")
     parser.add_argument("--write-registry", action="store_true",
@@ -38,8 +51,15 @@ def main(argv=None) -> int:
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
-    findings = lint.run(root, rules=rules,
-                        write_registry=args.write_registry)
+    try:
+        if not os.path.isdir(root):
+            raise OSError(f"--root {root!r} is not a directory")
+        findings = lint.run(root, rules=rules,
+                            write_registry=args.write_registry)
+    except Exception:
+        print("analyzer internal error:", file=sys.stderr)
+        traceback.print_exc()
+        return EXIT_INTERNAL_ERROR
 
     if args.json:
         print(json.dumps([{
@@ -49,7 +69,7 @@ def main(argv=None) -> int:
         for f in findings:
             print(f)
         print(f"{len(findings)} finding(s)")
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -33,6 +33,32 @@ examples from the fixes this tool forced):
   (``donate_argnums``) of a jitted dispatch must not be read after the
   call: donation invalidates the buffer, and XLA is free to overwrite
   it in place.
+- **R6 retrace risk** — the statically-visible jit cache busters
+  ``watched_jit`` can only report after the fact: a jit constructed and
+  invoked in one expression (fresh empty cache per call), a jit factory
+  called inside a loop body, a non-hashable literal passed in a
+  ``static_argnums`` position (``TypeError`` at dispatch), a static
+  argument fed from the enclosing loop variable (one compile per
+  iteration), and a traced function closing over module-level mutable
+  state that is mutated elsewhere (the trace freezes a stale value).
+- **R7 hidden host<->device transfers** — ``float()``/``int()``/
+  ``bool()``/``np.asarray()``/``np.array()`` applied to a value that
+  data-flows from a jitted dispatch or a ``jnp.*`` computation, in
+  host code outside the audited sink scope (eval fast path, metrics
+  decode, checkpoint host-snapshot): each such cast is a blocking
+  device->host round trip hiding in a hot path.
+- **R8 lockset guarded-field drift** — within one class, a ``self._x``
+  attribute written both inside a ``with <lock>:`` region and bare (in
+  any method other than ``__init__``), or guarded by two *disjoint*
+  locks: the unguarded (or differently-guarded) write races every
+  reader that trusts the lock.  Methods named ``*_locked`` are
+  guarded-by-convention (the caller holds the lock).
+
+R1 reachability and R3's blocking fixpoint are **whole-program**: the
+cross-module call graph (``tools.analyze.callgraph``) resolves the
+repo's own imports, so a traced helper or blocking primitive defined a
+module away is still caught (``run``/``lint_file`` thread the global
+seeds through; ``lint_source`` on one blob stays intra-module).
 
 Suppressions: ``# dl4j-lint: disable=R3 <reason>`` on the finding's
 line or the line above.  The reason is mandatory and audited — a
@@ -50,7 +76,7 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 #: paths (relative, slash-normalized prefixes or exact files) under the
 #: atomic-write contract (R2)
@@ -66,6 +92,18 @@ R2_SCOPE = (
 
 #: the one blessed implementation R2 routes everything through
 R2_EXEMPT = ("deeplearning4j_tpu/utils/fileio.py",)
+
+#: audited host-decode sink sites where R7 casts are the POINT — the
+#: eval fast path decodes argmax indices, health/metrics decode the
+#: packed stats vector, checkpoint/serializer snapshot params to host,
+#: and the serving layer returns host arrays at the request boundary
+R7_SINK_SCOPE = (
+    "deeplearning4j_tpu/eval/",
+    "deeplearning4j_tpu/monitor/health.py",
+    "deeplearning4j_tpu/resilience/checkpoint.py",
+    "deeplearning4j_tpu/utils/model_serializer.py",
+    "deeplearning4j_tpu/deploy/store.py",
+)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*dl4j-lint:\s*disable=([A-Za-z0-9,]+)\s*(.*?)\s*$")
@@ -180,19 +218,28 @@ class _FunctionInfo:
         self.node = node
         self.cls = cls
         self.name = node.name
-        self.calls: Set[str] = set()       # bare callee names
+        self.qname = f"{cls}.{node.name}" if cls else node.name
+        self.calls: Set[str] = set()       # resolved callee qnames
         self.blocking_sites: List[Tuple[int, str]] = []
 
 
 class _ModuleIndex:
-    """Per-module tables: functions (by bare name), intra-module call
+    """Per-module tables: functions (keyed by CLASS-QUALIFIED name, so
+    two classes' same-named methods never conflate), intra-module call
     edges, jit roots, and donated-jit bindings."""
 
     def __init__(self, tree: ast.Module):
+        #: qualified name ("Cls.meth" or bare for module-level/nested)
+        #: -> info; bare-name view in :attr:`by_bare`
         self.functions: Dict[str, _FunctionInfo] = {}
+        self.by_bare: Dict[str, List[str]] = {}
         self.jit_roots: Set[str] = set()
         # binding name -> donate arg positions
         self.donated: Dict[str, Tuple[int, ...]] = {}
+        # binding name -> static arg positions (R6)
+        self.static_bindings: Dict[str, Tuple[int, ...]] = {}
+        # every name bound to a jit/watched_jit factory result (R7)
+        self.jit_bindings: Set[str] = set()
         self._collect(tree)
 
     # -- collection -----------------------------------------------------
@@ -208,8 +255,9 @@ class _ModuleIndex:
                 return
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info = _FunctionInfo(node, cls_stack[-1])
-                self.functions[node.name] = info
-                self._scan_decorators(node)
+                self.functions[info.qname] = info
+                self.by_bare.setdefault(node.name, []).append(info.qname)
+                self._scan_decorators(node, info.qname)
                 for child in ast.iter_child_nodes(node):
                     visit(child)
                 return
@@ -221,26 +269,62 @@ class _ModuleIndex:
                 visit(child)
 
         visit(tree)
-        # call edges, computed once functions are known
+        # call edges, resolved class-aware once functions are known
         for info in self.functions.values():
             for sub in ast.walk(info.node):
                 if isinstance(sub, ast.Call):
-                    name = None
-                    if isinstance(sub.func, ast.Name):
-                        name = sub.func.id
-                    elif (isinstance(sub.func, ast.Attribute)
-                          and isinstance(sub.func.value, ast.Name)
-                          and sub.func.value.id in ("self", "cls")):
-                        name = sub.func.attr
-                    if name and name in self.functions:
-                        info.calls.add(name)
+                    q = self.resolve_callee(info.cls, sub)
+                    if q is not None:
+                        info.calls.add(q)
 
-    def _scan_decorators(self, node: ast.FunctionDef) -> None:
+    def resolve_callee(self, cls: Optional[str],
+                       call: ast.Call) -> Optional[str]:
+        """Qualified name of the local function a call hits, preferring
+        the caller's own class for ``self.x(...)`` and module level for
+        bare names; an ambiguous bare name resolves only when unique
+        (conservative under-approximation)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in self.functions:       # module-level / nested
+                return func.id
+            cands = self.by_bare.get(func.id, [])
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in ("self", "cls"):
+            if cls is not None:
+                q = f"{cls}.{func.attr}"
+                if q in self.functions:
+                    return q
+            if func.attr in self.functions:
+                return func.attr
+            cands = self.by_bare.get(func.attr, [])
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    def expand(self, names: Iterable[str]) -> Tuple[Set[str], Set[str]]:
+        """Split seed names into (local qualified names, foreign bare
+        names): a qname or bare name matching local functions expands to
+        the matching qnames; anything else (imported helpers the global
+        graph proved) stays bare for call-site matching."""
+        local: Set[str] = set()
+        foreign: Set[str] = set()
+        for n in names:
+            if n in self.functions:
+                local.add(n)
+            elif n in self.by_bare:
+                local.update(self.by_bare[n])
+            else:
+                foreign.add(n)
+        return local, foreign
+
+    def _scan_decorators(self, node: ast.FunctionDef,
+                         qname: str) -> None:
         for dec in node.decorator_list:
             name = _dotted(dec if not isinstance(dec, ast.Call)
                            else dec.func)
             if name and name.split(".")[-1] in _JIT_FACTORIES:
-                self.jit_roots.add(node.name)
+                self.jit_roots.add(qname)
 
     def _root_arg(self, call: ast.Call) -> Optional[str]:
         if call.args:
@@ -261,9 +345,10 @@ class _ModuleIndex:
             if root:
                 self.jit_roots.add(root)
 
-    def _donate_positions(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+    def _kw_positions(self, call: ast.Call,
+                      kwarg: str) -> Optional[Tuple[int, ...]]:
         for kw in call.keywords:
-            if kw.arg == "donate_argnums":
+            if kw.arg == kwarg:
                 return self._int_positions(kw.value)
         return None
 
@@ -294,18 +379,23 @@ class _ModuleIndex:
         name = _call_name(node.value)
         if name is None or name.split(".")[-1] not in _JIT_FACTORIES:
             return
-        donate = self._donate_positions(node.value)
-        if not donate:
-            return
+        donate = self._kw_positions(node.value, "donate_argnums")
+        static = self._kw_positions(node.value, "static_argnums")
         for tgt in node.targets:
             bound = _last_attr(tgt)
             if bound:
-                self.donated[bound] = donate
+                self.jit_bindings.add(bound)
+                if donate:
+                    self.donated[bound] = donate
+                if static:
+                    self.static_bindings[bound] = static
 
     # -- reachability ---------------------------------------------------
-    def traced_functions(self) -> Dict[str, _FunctionInfo]:
+    def traced_functions(
+            self, extra: Iterable[str] = ()) -> Dict[str, _FunctionInfo]:
         seen: Set[str] = set()
-        frontier = [r for r in self.jit_roots if r in self.functions]
+        roots, _ = self.expand(set(self.jit_roots) | set(extra))
+        frontier = list(roots)
         while frontier:
             cur = frontier.pop()
             if cur in seen:
@@ -330,9 +420,10 @@ def _walk_skipping_nested(fn: ast.FunctionDef) -> Iterable[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
-def _check_r1(index: _ModuleIndex, path: str) -> List[Finding]:
+def _check_r1(index: _ModuleIndex, path: str,
+              extra_traced: Iterable[str] = ()) -> List[Finding]:
     out: List[Finding] = []
-    for fname, info in index.traced_functions().items():
+    for fname, info in index.traced_functions(extra_traced).items():
         params = {a.arg for a in info.node.args.args
                   + info.node.args.kwonlyargs
                   + info.node.args.posonlyargs}
@@ -411,8 +502,17 @@ def _check_r2(tree: ast.Module, path: str) -> List[Finding]:
 
 # ------------------------------------------------------------------ R3
 
-def _is_blocking_call(node: ast.Call,
-                      blocking_fns: Set[str]) -> Optional[str]:
+def _is_blocking_call(node: ast.Call, blocking_fns: Set[str],
+                      xmod_fns: Set[str] = frozenset(),
+                      cls: Optional[str] = None,
+                      index: Optional[_ModuleIndex] = None
+                      ) -> Optional[str]:
+    """The blocking thing this call performs, or ``None``: a blocking
+    primitive, a local function the fixpoint proved blocking (resolved
+    class-aware through ``index`` — two classes' same-named methods
+    never conflate), or an imported helper the whole-program graph
+    proved blocking (``xmod_fns``, matched at module-alias call
+    sites)."""
     dotted = _call_name(node)
     if dotted in _R3_BLOCK_DOTTED:
         return dotted
@@ -424,32 +524,53 @@ def _is_blocking_call(node: ast.Call,
         if attr in ("get", "put") and any(
                 h in recv.lower() for h in _R3_QUEUE_HINTS):
             return f"{recv}.{attr}"
-        if isinstance(node.func.value, ast.Name) and \
-                node.func.value.id in ("self", "cls") and \
-                attr in blocking_fns:
-            return f"self.{attr}"
-    if isinstance(node.func, ast.Name) and node.func.id in blocking_fns:
-        return node.func.id
+        if recv in ("self", "cls"):
+            if index is not None:
+                q = index.resolve_callee(cls, node)
+                if q is not None and q in blocking_fns:
+                    return f"self.{attr}"
+            elif attr in blocking_fns:       # no index: bare matching
+                return f"self.{attr}"
+            return None      # self-calls never match imported names
+        # imported blocking helper called through a module alias
+        # (``wire._recv_exact(...)``) — names come from the global graph
+        if attr in xmod_fns:
+            return f"{recv}.{attr}" if recv else attr
+    if isinstance(node.func, ast.Name):
+        if index is not None:
+            q = index.resolve_callee(cls, node)
+            if q is not None and q in blocking_fns:
+                return node.func.id
+        elif node.func.id in blocking_fns:
+            return node.func.id
+        if node.func.id in xmod_fns:
+            return node.func.id
     return None
 
 
-def _blocking_fixpoint(index: _ModuleIndex) -> Set[str]:
-    """Names of module functions that (transitively) perform a blocking
-    call — so R3 sees through local helpers like ``_recv_exact``."""
-    blocking: Set[str] = set()
+def _blocking_fixpoint(index: _ModuleIndex,
+                       extra: Iterable[str] = ()) -> Tuple[Set[str],
+                                                           Set[str]]:
+    """(qualified names of module functions that transitively perform a
+    blocking call, foreign bare names) — so R3 sees through local
+    helpers like ``_recv_exact``.  ``extra`` seeds names the
+    WHOLE-PROGRAM graph already proved blocking: local qnames from the
+    cross-module fixpoint plus bare names of imported wire helpers."""
+    blocking, xmod = index.expand(extra)
     changed = True
     while changed:
         changed = False
-        for name, info in index.functions.items():
-            if name in blocking:
+        for qname, info in index.functions.items():
+            if qname in blocking:
                 continue
             for node in ast.walk(info.node):
                 if isinstance(node, ast.Call) and \
-                        _is_blocking_call(node, blocking):
-                    blocking.add(name)
+                        _is_blocking_call(node, blocking, xmod,
+                                          cls=info.cls, index=index):
+                    blocking.add(qname)
                     changed = True
                     break
-    return blocking
+    return blocking, xmod
 
 
 def _lockish(expr: ast.AST) -> Optional[str]:
@@ -460,13 +581,23 @@ def _lockish(expr: ast.AST) -> Optional[str]:
     return name if "lock" in tail or tail in ("_mu", "_meta") else None
 
 
-def _check_r3(tree: ast.Module, index: _ModuleIndex,
-              path: str) -> List[Finding]:
+def _check_r3(tree: ast.Module, index: _ModuleIndex, path: str,
+              extra_blocking: Iterable[str] = ()) -> List[Finding]:
     out: List[Finding] = []
-    blocking_fns = _blocking_fixpoint(index)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.With):
-            continue
+    blocking_fns, xmod = _blocking_fixpoint(index, extra_blocking)
+    # With sites paired with their enclosing class so self-calls
+    # resolve against the right class's methods
+    sites: List[Tuple[ast.With, Optional[str]]] = []
+    seen_withs: Set[int] = set()
+    for info in index.functions.values():
+        for node in _walk_skipping_nested(info.node):
+            if isinstance(node, ast.With):
+                sites.append((node, info.cls))
+                seen_withs.add(id(node))
+    for node in ast.walk(tree):      # module/class-level With blocks
+        if isinstance(node, ast.With) and id(node) not in seen_withs:
+            sites.append((node, None))
+    for node, cls in sites:
         lock_names = [n for n in
                       (_lockish(item.context_expr) for item in node.items)
                       if n]
@@ -478,7 +609,8 @@ def _check_r3(tree: ast.Module, index: _ModuleIndex,
                               (ast.FunctionDef, ast.AsyncFunctionDef)):
                     continue
                 if isinstance(inner, ast.Call):
-                    what = _is_blocking_call(inner, blocking_fns)
+                    what = _is_blocking_call(inner, blocking_fns, xmod,
+                                             cls=cls, index=index)
                     if what:
                         out.append(Finding(
                             "R3", path, inner.lineno,
@@ -547,6 +679,352 @@ def _rebound_names(fn: ast.FunctionDef, call: ast.Call) -> Set[str]:
     return set()
 
 
+# ------------------------------------------------------------------ R6
+
+#: mutating methods on module-level containers (R6 closure shape)
+_R6_MUT_METHODS = {"append", "extend", "update", "setdefault", "pop",
+                   "insert", "clear", "remove", "add", "popitem",
+                   "discard", "appendleft"}
+
+_R6_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                  ast.DictComp)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _static_calls(node: ast.AST,
+                  index: _ModuleIndex) -> Iterable[
+                      Tuple[ast.Call, str, Tuple[int, ...]]]:
+    """Calls (anywhere under ``node``) whose callee is a known
+    ``static_argnums`` jit binding, with the static positions."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        callee = _last_attr(sub.func)
+        if callee in index.static_bindings:
+            yield sub, callee, index.static_bindings[callee]
+
+
+def _check_r6(index: _ModuleIndex, tree: ast.Module, path: str,
+              extra_traced: Iterable[str] = ()) -> List[Finding]:
+    out: List[Finding] = []
+    flagged_factory_lines: Set[int] = set()
+
+    # -- R6 shape 1: a jit constructed and invoked in one expression —
+    # the returned callable (and its compile cache) dies with the
+    # statement, so every execution recompiles from scratch
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Call):
+            continue
+        inner = _call_name(node.func)
+        if inner and inner.split(".")[-1] in _JIT_FACTORIES:
+            flagged_factory_lines.add(node.func.lineno)
+            out.append(Finding(
+                "R6", path, node.lineno,
+                f"retrace risk: `{inner}(...)(...)` constructs and "
+                "invokes a jit in one expression — the compile cache "
+                "is thrown away with the callable, so this recompiles "
+                "on every execution; bind the jitted function once and "
+                "reuse it"))
+
+    # -- R6 shape 2: a jit factory called inside a loop body — one
+    # fresh cache (and compile) per iteration
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for body_stmt in loop.body + loop.orelse:
+            for sub in ast.walk(body_stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    continue
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _call_name(sub)
+                if name and name.split(".")[-1] in _JIT_FACTORIES and \
+                        sub.lineno not in flagged_factory_lines:
+                    flagged_factory_lines.add(sub.lineno)
+                    out.append(Finding(
+                        "R6", path, sub.lineno,
+                        f"retrace risk: `{name}(...)` is called inside "
+                        "a loop body — each iteration builds a fresh "
+                        "jit with an empty cache; hoist the factory "
+                        "out of the loop"))
+
+    # -- R6 shape 3: non-hashable literal in a static_argnums position
+    # (TypeError at dispatch: static args are cache keys)
+    for call, callee, positions in _static_calls(tree, index):
+        for pos in positions:
+            if pos < len(call.args) and \
+                    isinstance(call.args[pos], _R6_UNHASHABLE):
+                out.append(Finding(
+                    "R6", path, call.lineno,
+                    f"retrace risk: call to `{callee}` passes a "
+                    f"non-hashable literal at static_argnums position "
+                    f"{pos} — static args are hashed as cache keys, "
+                    "this raises TypeError at dispatch; pass a tuple "
+                    "or hoist to a hashable constant"))
+
+    # -- R6 shape 4: a static argument fed from the enclosing loop
+    # variable — every iteration is a new cache key, so the loop
+    # compiles once per step
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.For):
+            continue
+        loop_vars = _names_in(loop.target)
+        for call, callee, positions in _static_calls(loop, index):
+            for pos in positions:
+                if pos < len(call.args) and \
+                        _names_in(call.args[pos]) & loop_vars:
+                    out.append(Finding(
+                        "R6", path, call.lineno,
+                        f"retrace risk: call to `{callee}` passes loop "
+                        f"variable(s) "
+                        f"{sorted(_names_in(call.args[pos]) & loop_vars)}"
+                        f" at static_argnums position {pos} — every "
+                        "iteration is a new cache key, compiling once "
+                        "per step; make the argument traced or hoist "
+                        "it out of the loop"))
+
+    # -- R6 shape 5: traced function closing over module-level mutable
+    # state that is mutated elsewhere — the trace freezes the value it
+    # saw at compile time, silently ignoring later mutation
+    mutables: Dict[str, int] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and (
+                isinstance(stmt.value, (ast.List, ast.Dict, ast.Set))
+                or (isinstance(stmt.value, ast.Call)
+                    and _call_name(stmt.value) in ("list", "dict",
+                                                   "set"))):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    mutables[tgt.id] = stmt.lineno
+    mutated: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _R6_MUT_METHODS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in mutables:
+            mutated.add(node.func.value.id)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in mutables:
+                    mutated.add(tgt.value.id)
+    if mutated:
+        for fname, info in index.traced_functions(extra_traced).items():
+            shadowed = {a.arg for a in info.node.args.args
+                        + info.node.args.kwonlyargs
+                        + info.node.args.posonlyargs}
+            for node in _walk_skipping_nested(info.node):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Store):
+                    shadowed.add(node.id)
+            seen_here: Set[str] = set()
+            for node in _walk_skipping_nested(info.node):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in mutated and \
+                        node.id not in shadowed and \
+                        node.id not in seen_here:
+                    seen_here.add(node.id)
+                    out.append(Finding(
+                        "R6", path, node.lineno,
+                        f"retrace risk: traced `{fname}` reads "
+                        f"module-level mutable `{node.id}` (defined "
+                        f"line {mutables[node.id]}) which is mutated "
+                        "elsewhere in this module — the trace freezes "
+                        "the value seen at compile time and ignores "
+                        "the mutation; pass it as an argument"))
+    return out
+
+
+# ------------------------------------------------------------------ R7
+
+_R7_CASTS = {"float", "int", "bool"}
+_R7_NP_MODULES = {"np", "numpy", "onp"}
+_R7_NP_FUNCS = {"asarray", "array"}
+
+
+def _r7_device_source(call: ast.Call, index: _ModuleIndex) -> bool:
+    """Does this call produce a device value: a ``jnp.*``/``jax.numpy.*``
+    computation or an invocation of a jit/watched_jit binding (including
+    ``self._step(...)``)?"""
+    name = _call_name(call)
+    if name is None:
+        return False
+    if name.startswith(("jnp.", "jax.numpy.")):
+        return True
+    return name.split(".")[-1] in index.jit_bindings
+
+
+#: attribute accesses that read array METADATA, not array data — no
+#: transfer happens (``int(x.shape[0])`` is host-side bookkeeping)
+_R7_META_ATTRS = {"shape", "ndim", "size", "dtype", "sharding",
+                  "itemsize", "nbytes"}
+
+
+def _r7_base_name(node: ast.AST) -> Optional[ast.AST]:
+    """Strip subscripts/attributes: ``out[0].loss`` -> ``out``; a chain
+    through a metadata attribute (``x.shape[0]``) carries no device
+    data, so it strips to nothing."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _R7_META_ATTRS:
+            return None
+        node = node.value
+    return node
+
+
+def _check_r7(index: _ModuleIndex, tree: ast.Module, path: str,
+              extra_traced: Iterable[str] = ()) -> List[Finding]:
+    out: List[Finding] = []
+    traced = set(index.traced_functions(extra_traced))
+    for fname, info in index.functions.items():
+        if fname in traced:
+            continue          # host syncs in traced code are R1's domain
+        fn = info.node
+        tainted: Set[str] = set()
+        for node in _walk_skipping_nested(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _r7_device_source(node.value, index):
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for elt in elts:
+                        base = _r7_base_name(elt)
+                        if isinstance(base, ast.Name):
+                            tainted.add(base.id)
+        for node in _walk_skipping_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in _R7_CASTS and len(node.args) == 1 \
+                    and not node.keywords:
+                arg = node.args[0]
+                what = f"{name}(...)"
+            elif name and "." in name and \
+                    name.split(".")[0] in _R7_NP_MODULES and \
+                    name.split(".")[-1] in _R7_NP_FUNCS and node.args:
+                arg = node.args[0]
+                what = f"{name}(...)"
+            else:
+                continue
+            base = _r7_base_name(arg)
+            hit = None
+            if isinstance(base, ast.Name) and base.id in tainted:
+                hit = base.id
+            elif isinstance(base, ast.Call) and \
+                    _r7_device_source(base, index):
+                hit = _call_name(base)
+            if hit:
+                out.append(Finding(
+                    "R7", path, node.lineno,
+                    f"hidden transfer: `{what}` on `{hit}`, which "
+                    f"data-flows from a jitted dispatch/device "
+                    f"computation in `{fname}` — each cast is a "
+                    "blocking device->host round trip; batch the "
+                    "decode into an audited sink (eval fast path, "
+                    "metrics decode) or keep the value on device"))
+    return out
+
+
+# ------------------------------------------------------------------ R8
+
+_R8_SENTINEL = "<locked-method>"
+
+
+def _check_r8(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out += _check_r8_class(node, path)
+    return out
+
+
+def _check_r8_class(cls: ast.ClassDef, path: str) -> List[Finding]:
+    # (lineno, lockset, method, attr) for every ``self.<attr> = ...``
+    writes: List[Tuple[int, frozenset, str, str]] = []
+
+    def visit(node: ast.AST, locks: frozenset, method: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return            # nested defs: their own scope
+        if isinstance(node, ast.With):
+            held = [n for n in (_lockish(i.context_expr)
+                                for i in node.items) if n]
+            inner = locks | frozenset(held) if held else locks
+            for item in node.items:
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, locks, method)
+            for child in node.body:
+                visit(child, inner, method)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and \
+                        _lockish(tgt) is None:
+                    writes.append((node.lineno, locks, method, tgt.attr))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locks, method)
+
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name == "__init__":
+            continue          # construction races nothing
+        base = frozenset((_R8_SENTINEL,)) \
+            if item.name.endswith("_locked") else frozenset()
+        for child in item.body:
+            visit(child, base, item.name)
+
+    by_attr: Dict[str, List[Tuple[int, frozenset, str]]] = {}
+    for lineno, locks, method, attr in writes:
+        by_attr.setdefault(attr, []).append((lineno, locks, method))
+
+    out: List[Finding] = []
+    for attr, sites in sorted(by_attr.items()):
+        guarded = [s for s in sites if s[1]]
+        bare = [s for s in sites if not s[1]]
+        if guarded and bare:
+            g_line, g_locks, g_method = guarded[0]
+            lock_name = next((n for n in sorted(g_locks)
+                              if n != _R8_SENTINEL), _R8_SENTINEL)
+            for lineno, _, method in bare:
+                out.append(Finding(
+                    "R8", path, lineno,
+                    f"lockset drift: `self.{attr}` is written bare in "
+                    f"`{cls.name}.{method}` but under `{lock_name}` in "
+                    f"`{g_method}` (line {g_line}) — the unguarded "
+                    "write races every reader that trusts the lock; "
+                    "guard it or rename the method `*_locked`"))
+        # disjoint real locksets: two writers each think they hold THE
+        # lock, but they hold different ones
+        real = [s for s in guarded if _R8_SENTINEL not in s[1]]
+        for i in range(1, len(real)):
+            if not (real[i][1] & real[0][1]):
+                out.append(Finding(
+                    "R8", path, real[i][0],
+                    f"lockset drift: `self.{attr}` is written under "
+                    f"`{sorted(real[i][1])[0]}` in "
+                    f"`{cls.name}.{real[i][2]}` but under "
+                    f"`{sorted(real[0][1])[0]}` in `{real[0][2]}` "
+                    f"(line {real[0][0]}) — disjoint locks guard "
+                    "nothing; pick one lock for this field"))
+                break
+    return out
+
+
 # ----------------------------------------------------------- file driver
 
 def _in_scope(path: str, scope: Sequence[str]) -> bool:
@@ -557,24 +1035,36 @@ def _in_scope(path: str, scope: Sequence[str]) -> bool:
 
 def lint_source(source: str, path: str = "<string>",
                 rules: Optional[Iterable[str]] = None,
-                collect_suppressions: bool = False):
-    """Lint one source blob.  ``rules`` defaults to R1/R2/R3/R5 (R4 is
-    repo-level).  Returns findings, or ``(findings, suppressions)`` when
-    ``collect_suppressions`` — already filtered through the suppression
-    directives, with reasonless/unused directives reported as ``SUP``
-    findings."""
-    active = set(rules) if rules is not None else {"R1", "R2", "R3", "R5"}
+                collect_suppressions: bool = False,
+                extra_traced: Iterable[str] = (),
+                extra_blocking: Iterable[str] = ()):
+    """Lint one source blob.  ``rules`` defaults to every per-file rule
+    (R4 is repo-level).  ``extra_traced``/``extra_blocking`` seed the
+    intra-module reachability/fixpoint with names the whole-program
+    graph proved traced/blocking (``run`` supplies them; a bare
+    ``lint_source`` stays intra-module).  Returns findings, or
+    ``(findings, suppressions)`` when ``collect_suppressions`` —
+    already filtered through the suppression directives, with
+    reasonless/unused directives reported as ``SUP`` findings."""
+    active = set(rules) if rules is not None else {
+        "R1", "R2", "R3", "R5", "R6", "R7", "R8"}
     tree = ast.parse(source)
     index = _ModuleIndex(tree)
     findings: List[Finding] = []
     if "R1" in active:
-        findings += _check_r1(index, path)
+        findings += _check_r1(index, path, extra_traced)
     if "R2" in active:
         findings += _check_r2(tree, path)
     if "R3" in active:
-        findings += _check_r3(tree, index, path)
+        findings += _check_r3(tree, index, path, extra_blocking)
     if "R5" in active:
         findings += _check_r5(index, tree, path)
+    if "R6" in active:
+        findings += _check_r6(index, tree, path, extra_traced)
+    if "R7" in active:
+        findings += _check_r7(index, tree, path, extra_traced)
+    if "R8" in active:
+        findings += _check_r8(tree, path)
 
     sups = parse_suppressions(source)
     kept: List[Finding] = []
@@ -605,15 +1095,21 @@ def lint_source(source: str, path: str = "<string>",
     return kept
 
 
-def lint_file(path: str, repo_root: str) -> List[Finding]:
+def lint_file(path: str, repo_root: str,
+              extra_traced: Iterable[str] = (),
+              extra_blocking: Iterable[str] = ()) -> List[Finding]:
     rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
     with open(path, encoding="utf-8") as fh:
         source = fh.read()
-    rules = {"R1", "R3", "R5"}
+    rules = {"R1", "R3", "R5", "R6", "R8"}
+    if not _in_scope(rel, R7_SINK_SCOPE):
+        rules.add("R7")
     if _in_scope(rel, R2_SCOPE) and not _in_scope(rel, R2_EXEMPT):
         rules.add("R2")
     try:
-        return lint_source(source, rel, rules)
+        return lint_source(source, rel, rules,
+                           extra_traced=extra_traced,
+                           extra_blocking=extra_blocking)
     except SyntaxError as exc:
         return [Finding("SYN", rel, exc.lineno or 0,
                         f"syntax error: {exc.msg}")]
@@ -807,12 +1303,30 @@ def check_registry(root: str, write: bool = False) -> List[Finding]:
 
 def run(root: str, rules: Optional[Iterable[str]] = None,
         write_registry: bool = False) -> List[Finding]:
-    """Lint the whole repo.  Returns every surviving finding."""
+    """Lint the whole repo.  Builds the cross-module call graph once so
+    every per-file check sees the whole-program traced/blocking sets.
+    Returns every surviving finding."""
     active = set(rules) if rules is not None else set(ALL_RULES)
     findings: List[Finding] = []
-    if active & {"R1", "R2", "R3", "R5"}:
+    if active & {"R1", "R2", "R3", "R5", "R6", "R7", "R8"}:
+        from tools.analyze import callgraph
+        prog = callgraph.load(root)
+        g_traced = prog.traced()
+        g_blocking = prog.blocking()
+        g_block_imports = prog.blocking_imports(g_blocking)
         for path in _code_files(root):
-            file_findings = lint_file(path, root)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            mod = prog.by_path.get(rel)
+            extra_traced: Set[str] = set()
+            extra_blocking: Set[str] = set()
+            if mod is not None:
+                extra_traced = g_traced.get(mod.name, set())
+                extra_blocking = (
+                    set(g_blocking.get(mod.name, set()))
+                    | g_block_imports.get(mod.name, set()))
+            file_findings = lint_file(path, root,
+                                      extra_traced=extra_traced,
+                                      extra_blocking=extra_blocking)
             findings += [f for f in file_findings
                          if f.rule in active or f.rule in ("SUP", "SYN")]
     if "R4" in active:

@@ -1,0 +1,318 @@
+"""Runtime dispatch sanitizer: the dynamic half of analyzer v2.
+
+The static rules (R6/R7/R8) prove what the AST can prove; this module
+enforces at runtime the contracts the repo's perf work depends on but
+nothing asserted — armed by ``DL4J_TPU_SANITIZE=1`` and wired into
+``monitor.jit_watch`` (every ``watched_jit`` dispatch reports here) and
+the fit/serving scenario sites:
+
+- **zero recompiles after warmup** — once the driver calls
+  :func:`end_warmup` (bench warmup loop done, serving engine primed), a
+  ``watched_jit`` seeing a NEW abstract signature on an
+  already-compiled function is a violation: shape churn turned
+  "compiled once" into compile-per-step.
+- **dispatch-count ceilings per scenario** — :func:`scenario` brackets
+  one logical unit of work (one fused-epoch fit group, one RNN serving
+  step) and counts the ``watched_jit`` dispatches inside it against the
+  per-scenario budget declared in ``tools/analyze/budgets.json``
+  (ceiling = ``units * max_dispatches_per_unit + extra``).  The
+  one-dispatch-per-epoch and one-dispatch-per-RNN-step contracts stop
+  being "tested once" and become asserted on every armed run.  The
+  FIRST occurrence of each scenario name is warmup (compile probes and
+  cost-analysis lowering inflate it) — recorded, not enforced.
+- **donation verification** — every ``donate_argnums`` input buffer
+  must actually report deleted after dispatch.  jax silently skips
+  donation it cannot use (no aliasable output slot), which un-halves
+  the fused step's HBM high-water mark without a single warning;
+  ``sanitizer_donation_misses_total`` catches it.
+  ``DL4J_TPU_SANITIZE_DONATION=off`` disables the audit on platforms
+  that do not implement donation.
+
+Violations increment ``sanitizer_violations_total{kind=...}`` (plus the
+per-kind counters), drop a ``sanitizer_violation`` flight-recorder
+bundle, and — under ``DL4J_TPU_SANITIZE_STRICT=1`` — raise
+:class:`SanitizerViolation` at the detection site.
+
+Like the rest of ``tools.analyze`` this module imports neither jax nor
+the monitor package at import time (the monitor resolves lazily, the
+jax-touching audit lives in ``jit_watch`` which already imports jax),
+so ``python -m tools.analyze`` stays a pre-pip-install CI gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+ENV_FLAG = "DL4J_TPU_SANITIZE"
+ENV_STRICT = "DL4J_TPU_SANITIZE_STRICT"
+ENV_BUDGETS = "DL4J_TPU_SANITIZE_BUDGETS"
+ENV_DONATION = "DL4J_TPU_SANITIZE_DONATION"
+
+_TRUE = ("1", "true", "yes")
+
+DEFAULT_BUDGETS_PATH = os.path.join(os.path.dirname(__file__),
+                                    "budgets.json")
+
+VIOLATIONS_TOTAL = "sanitizer_violations_total"
+RECOMPILES_TOTAL = "sanitizer_recompiles_after_warmup_total"
+DONATION_MISSES_TOTAL = "sanitizer_donation_misses_total"
+BUDGET_EXCEEDED_TOTAL = "sanitizer_dispatch_budget_exceeded_total"
+
+
+class SanitizerViolation(RuntimeError):
+    """A dispatch-discipline contract was broken under strict mode."""
+
+
+def enabled() -> bool:
+    """Whether the sanitizer is armed (``DL4J_TPU_SANITIZE=1``)."""
+    return os.environ.get(ENV_FLAG, "") in _TRUE
+
+
+def strict() -> bool:
+    return os.environ.get(ENV_STRICT, "") in _TRUE
+
+
+def donation_audit() -> bool:
+    """Whether donated-buffer verification is on (default yes; set
+    ``DL4J_TPU_SANITIZE_DONATION=off`` on platforms without donation)."""
+    return os.environ.get(ENV_DONATION, "auto").lower() != "off"
+
+
+def _metrics():
+    try:
+        from deeplearning4j_tpu import monitor as _monitor
+        return _monitor
+    except Exception:
+        return None
+
+
+def _flight(kind: str, detail: dict) -> None:
+    try:
+        from deeplearning4j_tpu.monitor import record_incident
+        record_incident(kind, detail)
+    except Exception:
+        pass
+
+
+def load_budgets(path: Optional[str] = None) -> Dict[str, dict]:
+    """Per-scenario budgets: ``{name: {"max_dispatches_per_unit": n}}``.
+    ``DL4J_TPU_SANITIZE_BUDGETS`` overrides the packaged file."""
+    path = path or os.environ.get(ENV_BUDGETS) or DEFAULT_BUDGETS_PATH
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return {k: v for k, v in data.items()
+            if isinstance(v, dict) and not k.startswith("_")}
+
+
+class _Scenario:
+    __slots__ = ("name", "units", "extra", "dispatches")
+
+    def __init__(self, name: str, units: int, extra: int):
+        self.name = name
+        self.units = max(1, int(units))
+        self.extra = max(0, int(extra))
+        self.dispatches = 0
+
+
+class Sanitizer:
+    """Process-global violation collector (see module doc)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._warmup_over = False
+        self._seen_scenarios: Dict[str, int] = {}
+        self._violations: List[dict] = []
+        self._budgets: Optional[Dict[str, dict]] = None
+
+    # ------------------------------------------------------------ state
+    def budgets(self) -> Dict[str, dict]:
+        with self._mu:
+            if self._budgets is None:
+                self._budgets = load_budgets()
+            return self._budgets
+
+    def end_warmup(self) -> None:
+        """From here on, any recompile is a violation."""
+        with self._mu:
+            self._warmup_over = True
+
+    def warmed_up(self) -> bool:
+        with self._mu:
+            return self._warmup_over
+
+    def violations(self) -> List[dict]:
+        with self._mu:
+            return list(self._violations)
+
+    def violation_count(self) -> int:
+        with self._mu:
+            return len(self._violations)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._warmup_over = False
+            self._seen_scenarios.clear()
+            self._violations.clear()
+            self._budgets = None
+
+    def _stack(self) -> List[_Scenario]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    # -------------------------------------------------------- recording
+    def record_dispatch(self, fn: str, compiled: bool,
+                        recompile: bool) -> None:
+        """Every ``watched_jit`` dispatch lands here when armed."""
+        for scen in self._stack():
+            scen.dispatches += 1
+        if recompile and self.warmed_up():
+            mon = _metrics()
+            if mon is not None:
+                mon.counter(
+                    RECOMPILES_TOTAL,
+                    "recompiles observed after sanitize_end_warmup "
+                    "(each one is shape/static-arg churn)").inc(fn=fn)
+            self._violate("recompile_after_warmup", {
+                "fn": fn,
+                "hint": "a new abstract signature reached an "
+                        "already-compiled function after warmup; see "
+                        "the jit/compile trace span for the signature",
+            })
+
+    def record_donation(self, fn: str, missed: int, total: int) -> None:
+        """Post-dispatch donated-buffer audit result from jit_watch."""
+        if missed <= 0:
+            return
+        mon = _metrics()
+        if mon is not None:
+            mon.counter(
+                DONATION_MISSES_TOTAL,
+                "donated input buffers still live after dispatch "
+                "(donation silently unusable)").inc(missed, fn=fn)
+        self._violate("donation_miss", {
+            "fn": fn, "missed": missed, "total": total,
+            "hint": "a donate_argnums buffer was not consumed — the "
+                    "output has no aliasable slot of that shape/dtype, "
+                    "so the step's HBM high-water mark doubled "
+                    "silently",
+        })
+
+    # -------------------------------------------------------- scenarios
+    def scenario(self, name: str, units: int = 1, extra: int = 0):
+        """Context manager bracketing one unit of budgeted work."""
+        return _ScenarioContext(self, name, units, extra)
+
+    def _enter(self, scen: _Scenario) -> None:
+        self._stack().append(scen)
+
+    def _exit(self, scen: _Scenario) -> None:
+        stack = self._stack()
+        if scen in stack:
+            stack.remove(scen)
+        with self._mu:
+            occurrence = self._seen_scenarios.get(scen.name, 0)
+            self._seen_scenarios[scen.name] = occurrence + 1
+        budget = self.budgets().get(scen.name)
+        if budget is None or occurrence == 0:
+            return          # unbudgeted, or warmup occurrence
+        per_unit = int(budget.get("max_dispatches_per_unit", 0))
+        if per_unit <= 0:
+            return
+        ceiling = scen.units * per_unit + scen.extra
+        if scen.dispatches > ceiling:
+            mon = _metrics()
+            if mon is not None:
+                mon.counter(
+                    BUDGET_EXCEEDED_TOTAL,
+                    "scenarios whose dispatch count exceeded the "
+                    "budgets.json ceiling").inc(scenario=scen.name)
+            self._violate("dispatch_budget", {
+                "scenario": scen.name, "dispatches": scen.dispatches,
+                "ceiling": ceiling, "units": scen.units,
+                "extra": scen.extra,
+                "hint": "more jitted dispatches than the declared "
+                        "contract (e.g. one dispatch per fused epoch "
+                        "group) — a fused path degraded to per-step "
+                        "dispatch",
+            })
+
+    # -------------------------------------------------------- violations
+    def _violate(self, kind: str, detail: dict) -> None:
+        entry = dict(detail, kind=kind)
+        with self._mu:
+            self._violations.append(entry)
+        mon = _metrics()
+        if mon is not None:
+            mon.counter(
+                VIOLATIONS_TOTAL,
+                "sanitizer contract violations by kind").inc(kind=kind)
+        _flight("sanitizer_violation", entry)
+        if strict():
+            raise SanitizerViolation(f"{kind}: {detail}")
+
+
+class _ScenarioContext:
+    __slots__ = ("_san", "_scen")
+
+    def __init__(self, san: Sanitizer, name: str, units: int,
+                 extra: int):
+        self._san = san
+        self._scen = _Scenario(name, units, extra)
+
+    def __enter__(self) -> _Scenario:
+        self._san._enter(self._scen)
+        return self._scen
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # budget enforcement only on the clean path: an exception mid-
+        # scenario already surfaces louder than a budget count would
+        if exc_type is None:
+            self._san._exit(self._scen)
+        else:
+            stack = self._san._stack()
+            if self._scen in stack:
+                stack.remove(self._scen)
+
+
+_SANITIZER = Sanitizer()
+
+
+def state() -> Sanitizer:
+    return _SANITIZER
+
+
+def end_warmup() -> None:
+    _SANITIZER.end_warmup()
+
+
+def scenario(name: str, units: int = 1, extra: int = 0):
+    return _SANITIZER.scenario(name, units=units, extra=extra)
+
+
+def record_dispatch(fn: str, compiled: bool, recompile: bool) -> None:
+    _SANITIZER.record_dispatch(fn, compiled, recompile)
+
+
+def record_donation(fn: str, missed: int, total: int) -> None:
+    _SANITIZER.record_donation(fn, missed, total)
+
+
+def violations() -> List[dict]:
+    return _SANITIZER.violations()
+
+
+def violation_count() -> int:
+    return _SANITIZER.violation_count()
+
+
+def reset() -> None:
+    _SANITIZER.reset()
