@@ -1941,6 +1941,23 @@ def _sanitizer_smoke_fields() -> dict:
                 {v["kind"] for v in sanitizer.violations()})}
 
 
+def _alert_smoke_fields() -> dict:
+    """One alert-engine pass over everything the smoke run published:
+    a clean run must leave every default rule in ``ok`` (the CI ingest
+    job asserts ``alerts_firing == []``).  Two passes so the windowed
+    rules also evaluate against a real ring sample, not just the
+    burst-from-zero path."""
+    from deeplearning4j_tpu.monitor import alerts
+    engine = alerts.AlertEngine(interval_s=0.1)
+    engine.evaluate_once()
+    statuses = engine.evaluate_once()
+    return {
+        "alerts_evaluated": len(statuses),
+        "alerts_firing": sorted(s["name"] for s in statuses
+                                if s["state"] == alerts.FIRING),
+    }
+
+
 def main() -> None:
     run_all = "--all" in sys.argv
     if "--chaos" in sys.argv:
@@ -1994,6 +2011,7 @@ def main() -> None:
         result = bench_lenet(batch=32, steps=8, trials=2, pipeline=1)
         result.update(_smoke_precision_fields(batch=32))
         result.update(_sanitizer_smoke_fields())
+        result.update(_alert_smoke_fields())
         print(json.dumps(result), flush=True)
         return
     if "--glove-smoke" in sys.argv:

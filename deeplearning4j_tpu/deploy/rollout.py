@@ -28,7 +28,10 @@ per-version latency windows the engine already exports:
   otherwise prediction agreement with the active version must reach
   ``min_agreement``;
 - **latency**: canary windowed p99 must stay within ``max_p99_ratio``
-  of active p99 (``serving_version_latency_ms``).
+  of active p99 (``serving_version_latency_ms``);
+- **alerts**: no gate-marked alert rule (``monitor/alerts.py``, e.g.
+  training divergence, serving SLO burn, checkpoint corruption) may be
+  firing on the process-global engine.
 
 On pass, ``promote`` is the engine's atomic pointer flip (old tree
 released to the pager, sessions stay pinned).  On fail, ``rollback``
@@ -240,6 +243,15 @@ class RolloutController:
                     f"canary p99 {sc['p99']:.1f} ms is {ratio:.2f}x "
                     f"active p99 {sa['p99']:.1f} ms "
                     f"(limit {self.max_p99_ratio}x)")
+        # extra canary gate: never promote while a gate-marked alert
+        # (divergence, SLO burn, shed storm, checkpoint corruption) is
+        # firing — the incident may well be the canary's fault, and a
+        # promote would make it the only version left to roll back to
+        firing = _monitor.alerts.gating_alerts()
+        if firing:
+            ok = False
+            reasons.append("alert(s) firing: " + ", ".join(firing))
+            res["alerts_firing"] = firing
         res["pass"] = ok
         res["reasons"] = reasons
         return res

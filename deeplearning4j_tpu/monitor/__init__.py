@@ -40,6 +40,11 @@ from .health import (TrainingDivergedError, disable as disable_health,
                      snapshot as health_snapshot)
 from . import flight_recorder
 from .flight_recorder import incident_dir, record_incident
+from . import alerts
+from .alerts import (AlertEngine, Rule, default_rules,
+                     status as alert_status)
+from . import attribution
+from .attribution import StepAttributor, breakdown as wall_breakdown
 from .jit_watch import WatchedJit, publish_cost_analysis, watched_jit
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, registry)
 from .tracing import (TraceContext, Tracer, attach, current_context,
@@ -47,9 +52,11 @@ from .tracing import (TraceContext, Tracer, attach, current_context,
                       tracer)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "TraceContext",
-    "Tracer", "TrainingDivergedError", "WatchedJit", "attach", "counter",
-    "current_context", "detach", "disable_health", "enable_health",
+    "AlertEngine", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Rule", "StepAttributor", "TraceContext", "Tracer",
+    "TrainingDivergedError", "WatchedJit", "alert_status", "alerts",
+    "attach", "attribution", "counter", "current_context",
+    "default_rules", "detach", "disable_health", "enable_health",
     "flight_recorder", "gauge", "health", "health_enabled",
     "health_snapshot", "histogram", "incident_dir", "new_trace_id",
     "observe_phase", "parse_traceparent", "phase_breakdown",
@@ -57,7 +64,7 @@ __all__ = [
     "record_incident", "registry", "reset", "sanitize_end_warmup",
     "sanitize_scenario", "snapshot", "span",
     "system_metrics_persistable", "trace_chrome_json", "trace_jsonl",
-    "tracer", "watched_jit",
+    "tracer", "wall_breakdown", "watched_jit",
 ]
 
 
@@ -226,3 +233,4 @@ def reset() -> None:
     tracer().clear()
     health.reset()
     flight_recorder.reset_rate_limit()
+    alerts.reset()

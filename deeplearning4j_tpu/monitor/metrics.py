@@ -205,6 +205,12 @@ class _HistogramSeries:
             "p95": self.quantile(0.95, res),
             "p99": self.quantile(0.99, res),
             "p999": self.quantile(0.999, res),
+            # per-bucket counts over BUCKET_BOUNDS (+Inf last): exact
+            # lifetime tallies, what the alert engine's burn-rate rules
+            # count "events above the SLO bound" from (the reservoir
+            # percentiles above are recency-biased and unsuitable for
+            # windowed event-rate math)
+            "buckets": list(self.buckets),
         }
         if self.exemplars:
             out["exemplars"] = {

@@ -140,6 +140,38 @@ class Tracer:
         self._local = threading.local()
         self._ids = itertools.count(1)
         self._active: Dict[int, Dict] = {}
+        self._dropped = 0
+
+    def _append(self, event: Dict, pop_active: Optional[int] = None
+                ) -> None:
+        """Ring append + silent-eviction accounting.  The deque evicts
+        its oldest span on overflow with no signal; counting the drops
+        makes a truncated ``/trace`` timeline detectable."""
+        with self._lock:
+            if pop_active is not None:
+                self._active.pop(pop_active, None)
+            dropped = (self._buf.maxlen is not None
+                       and len(self._buf) == self._buf.maxlen)
+            if dropped:
+                self._dropped += 1
+            self._buf.append(event)
+        if dropped:
+            # lazy: metrics.py imports this module at load time
+            from .metrics import registry as _registry
+            try:
+                _registry().counter(
+                    "trace_spans_dropped_total",
+                    "finished spans evicted unexported from the tracer "
+                    "ring buffer").inc()
+            except Exception:
+                pass
+
+    def dropped_count(self) -> int:
+        """Finished spans evicted from the ring since the last
+        :meth:`clear` — nonzero means :meth:`events` is a truncated
+        view of what actually ran."""
+        with self._lock:
+            return self._dropped
 
     # ---------------------------------------------------------------- ids
     def next_span_id(self) -> int:
@@ -234,9 +266,7 @@ class Tracer:
                 event["links"] = [int(l) for l in links]
             if attrs:
                 event["attrs"] = attrs
-            with self._lock:
-                self._active.pop(span_id, None)
-                self._buf.append(event)
+            self._append(event, pop_active=span_id)
 
     def record_span(self, name: str, *, trace_id: Union[int, str],
                     ts: float, dur_ms: float,
@@ -263,8 +293,7 @@ class Tracer:
             event["links"] = [int(l) for l in links]
         if attrs:
             event["attrs"] = attrs
-        with self._lock:
-            self._buf.append(event)
+        self._append(event)
         return int(span_id)
 
     # -------------------------------------------------------------- reading
@@ -340,6 +369,7 @@ class Tracer:
         with self._lock:
             self._buf.clear()
             self._active.clear()
+            self._dropped = 0
 
 
 _TRACER = Tracer()

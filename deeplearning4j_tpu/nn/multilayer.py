@@ -506,6 +506,11 @@ class MultiLayerNetwork:
 
         def dispatch(buf):
             t0 = time.perf_counter()
+            # straggler point inside the timed data phase, so an armed
+            # DL4J_TPU_FAULT_SLOW_WORKER_MS stall lands in phase_data_ms
+            # and the step attributor names "data" as the dominant
+            # component (monitor/attribution.py)
+            _faults.slow_worker()
             features, labels, fm, lm = ingest.stack_window(buf)
             u8, wire = ingest.window_wire(buf)
             if u8 is not None:
@@ -610,7 +615,10 @@ class MultiLayerNetwork:
                     "provide masks on all batches or none")
             return jnp.stack([jnp.asarray(get(b)) for b in batches])
 
+        from ..resilience import faults as _faults
         t0 = time.perf_counter()
+        # straggler point inside the timed data phase (see dispatch())
+        _faults.slow_worker()
         features = jnp.stack([jnp.asarray(b.features) for b in batches])
         labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
         fmask = stack_masks(lambda b: b.features_mask)
@@ -1016,8 +1024,11 @@ class MultiLayerNetwork:
         _monitor.observe_phase("listener", time.perf_counter() - t0)
 
     def _fit_batch(self, ds: DataSet) -> None:
+        from ..resilience import faults as _faults
         self.last_batch_size = ds.num_examples()
         t0 = time.perf_counter()
+        # straggler point inside the timed data phase (see dispatch())
+        _faults.slow_worker()
         features = jnp.asarray(ds.features)
         labels = jnp.asarray(ds.labels)
         fmask = (None if ds.features_mask is None

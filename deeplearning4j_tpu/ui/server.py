@@ -22,7 +22,11 @@ Runtime-telemetry export (the ``monitor`` package's process globals):
 
     GET  /metrics  -> Prometheus text exposition (counters/gauges/summaries)
     GET  /trace    -> Chrome trace events, one JSON object per line (wrap
-                      the lines in [...] for Perfetto / chrome://tracing)
+                      the lines in [...] for Perfetto / chrome://tracing);
+                      the X-Trace-Dropped response header counts spans the
+                      ring buffer evicted unexported (truncated timeline)
+    GET  /alerts   -> alert-engine state: per-rule config, ok/pending/
+                      firing, last reason/value, flight-bundle path
     GET  /healthz  -> liveness probe for scrapers, enriched with backend
                       platform, device count, last dispatch time, and
                       the ok/diverged training-health state
@@ -59,6 +63,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -338,7 +343,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/tsne/data":
             self._json(ui.tsne_data())
         elif path == "/metrics":
-            self._send(200, _monitor.prometheus_text().encode(),
+            # scrape self-telemetry: the cost of observability is itself
+            # observable (a slow/huge exposition shows on the NEXT scrape)
+            t0 = time.perf_counter()
+            body = _monitor.prometheus_text().encode()
+            _monitor.histogram(
+                "metrics_exposition_seconds",
+                "wall time to render the /metrics exposition").observe(
+                    time.perf_counter() - t0)
+            _monitor.gauge(
+                "metrics_exposition_bytes",
+                "size of the last rendered /metrics body").set(len(body))
+            self._send(200, body,
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/trace":
             trace_id = q.get("trace_id", [None])[0]
@@ -349,14 +365,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(400, json.dumps(
                     {"error": "limit must be an integer"}).encode())
                 return
+            dropped = {"X-Trace-Dropped":
+                       _monitor.tracer().dropped_count()}
             if q.get("format", [None])[0] == "chrome":
                 self._send(200, _monitor.trace_chrome_json(
                     trace_id=trace_id, name=name, limit=limit).encode(),
-                    "application/json")
+                    "application/json", headers=dropped)
             else:
                 self._send(200, _monitor.trace_jsonl(
                     trace_id=trace_id, name=name, limit=limit).encode(),
-                    "application/x-ndjson")
+                    "application/x-ndjson", headers=dropped)
+        elif path == "/alerts":
+            self._json(ui.alerts_data())
         elif path == "/healthz":
             self._json(ui.healthz_data())
         elif path == "/health":
@@ -675,6 +695,13 @@ class UIServer:
         per-layer grad/param/update statistics."""
         from .. import monitor as _mon
         return _mon.health.snapshot()
+
+    def alerts_data(self) -> dict:
+        """``GET /alerts`` body: the alert engine's status (a stub with
+        ``running: false`` when no engine has been created — reading
+        the endpoint must not conjure a watcher)."""
+        from .. import monitor as _mon
+        return _mon.alert_status()
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> "UIServer":

@@ -1,0 +1,252 @@
+"""Bench regression watch: ``python tools/perfwatch.py``.
+
+The repo commits one ``BENCH_r<N>.json`` per growth round (throughput +
+optional HBM bytes-per-step from the XLA cost analysis) and a static
+``tools/perf_baseline.json``.  Nothing watched the trajectory: a 20%
+throughput cliff only surfaced when a human diffed two bench logs.
+This tool is the standing gate — stdlib-only so CI can run it before
+any dependency install.
+
+Checks (each failed check is one finding):
+
+- **throughput drop** — the newest round's headline metric must not
+  fall more than ``--tolerance`` (default 10%) below the *median of the
+  trailing rounds* (default 4).  The trailing median, not the all-time
+  high-water mark, is the reference: the committed history legitimately
+  drifts as instrumentation grows, and a gate pinned to round 1 would
+  be permanently red while a real cliff at head stayed invisible.
+- **bytes-per-step growth** — ``hbm_bytes_per_step`` (when recorded)
+  must not grow more than ``--bytes-tolerance`` (default 10%) over the
+  smallest value in the history: memory per step creeping up is a
+  regression even when throughput holds.
+
+Output: findings on stdout (``--json`` for machine-readable) and a
+``PERF_REPORT.md`` snapshot of the trajectory + verdicts (suppress with
+``--no-report``).
+
+Exit status mirrors ``tools/analyze``'s contract so the same CI glue
+works: **0** clean, **1** at least one finding, **2** the watcher
+itself failed (unreadable history, internal crash) — "perf is dirty"
+and "the gate did not run" must be distinguishable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import traceback
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL_ERROR = 2
+
+DEFAULT_TOLERANCE = 0.10
+DEFAULT_BYTES_TOLERANCE = 0.10
+DEFAULT_TRAILING = 4
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+class Finding:
+    def __init__(self, check: str, message: str):
+        self.check = check
+        self.message = message
+
+    def __str__(self) -> str:
+        return f"[{self.check}] {self.message}"
+
+
+def load_rounds(root: str) -> list:
+    """The committed bench trajectory, oldest first: one record per
+    ``BENCH_r*.json`` whose top-level ``parsed`` block carries a
+    headline metric.  Unparseable files raise (internal error — the
+    history itself is part of the contract)."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        with open(path) as fh:
+            doc = json.load(fh)
+        parsed = doc.get("parsed") or {}
+        rounds.append({
+            "round": int(doc.get("n", m.group(1))),
+            "file": os.path.basename(path),
+            "rc": doc.get("rc"),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "batch": parsed.get("batch"),
+            "hbm_bytes_per_step": parsed.get("hbm_bytes_per_step"),
+        })
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def load_baseline(root: str) -> dict:
+    path = os.path.join(root, "tools", "perf_baseline.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def check_throughput(rounds: list, tolerance: float,
+                     trailing: int) -> list:
+    """Newest round vs the trailing median of its own metric."""
+    usable = [r for r in rounds
+              if r["value"] is not None and r["rc"] == 0]
+    if len(usable) < 2:
+        return []
+    head = usable[-1]
+    prior = [r["value"] for r in usable[:-1]
+             if r["metric"] == head["metric"]][-trailing:]
+    if not prior:
+        return []
+    base = statistics.median(prior)
+    if base <= 0:
+        return []
+    drop = (base - head["value"]) / base
+    head["throughput_drop_vs_trailing"] = round(drop, 4)
+    if drop > tolerance:
+        return [Finding(
+            "throughput",
+            f"{head['file']}: {head['metric']} = {head['value']:.1f} is "
+            f"{drop * 100:.1f}% below the trailing median "
+            f"{base:.1f} of the previous {len(prior)} round(s) "
+            f"(tolerance {tolerance * 100:.0f}%)")]
+    return []
+
+
+def check_bytes(rounds: list, tolerance: float) -> list:
+    """Newest recorded hbm_bytes_per_step vs the history minimum."""
+    series = [(r["file"], r["hbm_bytes_per_step"]) for r in rounds
+              if r["hbm_bytes_per_step"] is not None and r["rc"] == 0]
+    if len(series) < 2:
+        return []
+    head_file, head = series[-1]
+    best = min(v for _, v in series)
+    if best <= 0:
+        return []
+    growth = (head - best) / best
+    if growth > tolerance:
+        return [Finding(
+            "bytes-per-step",
+            f"{head_file}: hbm_bytes_per_step = {head:.0f} grew "
+            f"{growth * 100:.1f}% over the history minimum {best:.0f} "
+            f"(tolerance {tolerance * 100:.0f}%)")]
+    return []
+
+
+def write_report(path: str, rounds: list, findings: list,
+                 baseline: dict, args) -> None:
+    lines = [
+        "# Perf regression watch",
+        "",
+        "Generated by `python tools/perfwatch.py` over the committed",
+        "`BENCH_r*.json` trajectory (see docs/OBSERVABILITY.md, \"Perf",
+        "regression watch\").",
+        "",
+        f"- throughput tolerance: {args.tolerance * 100:.0f}% below the "
+        f"trailing-{args.trailing} median",
+        f"- bytes-per-step tolerance: "
+        f"{args.bytes_tolerance * 100:.0f}% above the history minimum",
+        "",
+        "## Trajectory",
+        "",
+        "| round | metric | value | batch | hbm bytes/step | rc |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rounds:
+        value = "-" if r["value"] is None else f"{r['value']:.1f}"
+        hbm = ("-" if r["hbm_bytes_per_step"] is None
+               else f"{r['hbm_bytes_per_step']:.0f}")
+        lines.append(
+            f"| r{r['round']:02d} | {r['metric'] or '-'} | {value} "
+            f"| {r['batch'] or '-'} | {hbm} | {r['rc']} |")
+    lines += ["", "## Verdict", ""]
+    if findings:
+        lines += [f"- **FAIL** {f}" for f in findings]
+    else:
+        lines.append("- **PASS** — no regression beyond tolerance")
+    if baseline:
+        lines += ["", "## Static baseline (tools/perf_baseline.json)",
+                  ""]
+        for name, vals in sorted(baseline.items()):
+            if isinstance(vals, dict):
+                detail = ", ".join(f"{k}={v:g}" if isinstance(v, float)
+                                   else f"{k}={v}"
+                                   for k, v in sorted(vals.items()))
+            else:
+                detail = str(vals)
+            lines.append(f"- `{name}`: {detail}")
+    lines.append("")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def run(root: str, args) -> list:
+    rounds = load_rounds(root)
+    findings = []
+    findings += check_throughput(rounds, args.tolerance, args.trailing)
+    findings += check_bytes(rounds, args.bytes_tolerance)
+    if not args.no_report:
+        write_report(args.report or os.path.join(root, "PERF_REPORT.md"),
+                     rounds, findings, load_baseline(root), args)
+    return findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/perfwatch.py",
+        description="bench-trajectory regression watch "
+                    "(BENCH_r*.json + tools/perf_baseline.json)")
+    parser.add_argument("--root", default=None,
+                        help="repo root holding BENCH_r*.json "
+                             "(default: this file's repo)")
+    parser.add_argument("--tolerance", type=float,
+                        default=DEFAULT_TOLERANCE,
+                        help="max fractional throughput drop vs the "
+                             "trailing median (default 0.10)")
+    parser.add_argument("--bytes-tolerance", type=float,
+                        default=DEFAULT_BYTES_TOLERANCE,
+                        help="max fractional hbm_bytes_per_step growth "
+                             "vs the history minimum (default 0.10)")
+    parser.add_argument("--trailing", type=int, default=DEFAULT_TRAILING,
+                        help="rounds in the trailing median (default 4)")
+    parser.add_argument("--report", default=None,
+                        help="report path (default <root>/PERF_REPORT.md)")
+    parser.add_argument("--no-report", action="store_true",
+                        help="skip writing the markdown report")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    try:
+        if not os.path.isdir(root):
+            raise OSError(f"--root {root!r} is not a directory")
+        findings = run(root, args)
+    except Exception:
+        print("perfwatch internal error:", file=sys.stderr)
+        traceback.print_exc()
+        return EXIT_INTERNAL_ERROR
+
+    if args.json:
+        print(json.dumps([{"check": f.check, "message": f.message}
+                          for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"{len(findings)} finding(s)")
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())
