@@ -17,14 +17,20 @@ data motion:
   version N+1 in alongside N, canaries a traffic fraction, gates on
   per-version p99 + accuracy/agreement, then promotes (atomic pointer
   flip) or auto-rolls-back with a ``rollout_rollback`` flight-recorder
-  bundle.
+  bundle;
+- :class:`~deeplearning4j_tpu.deploy.rollout.FleetCanary`: the fleet
+  generalization — ramps ONE worker's route fraction through the
+  ``serving.fleet.FleetRouter`` while the router's windowed p99 holds,
+  aborting back to a fallback fraction on breach.
 """
 
-from .rollout import CANARY, IDLE, RolloutController, RolloutError
+from .rollout import (CANARY, IDLE, FleetCanary, RolloutController,
+                      RolloutError)
 from .store import (DeploymentListener, ParamServerPoller,
                     VersionedWeightStore, WeightSnapshot,
                     WeightStoreCorruptError, tree_from_flat)
 
-__all__ = ["CANARY", "DeploymentListener", "IDLE", "ParamServerPoller",
-           "RolloutController", "RolloutError", "VersionedWeightStore",
-           "WeightSnapshot", "WeightStoreCorruptError", "tree_from_flat"]
+__all__ = ["CANARY", "DeploymentListener", "FleetCanary", "IDLE",
+           "ParamServerPoller", "RolloutController", "RolloutError",
+           "VersionedWeightStore", "WeightSnapshot",
+           "WeightStoreCorruptError", "tree_from_flat"]

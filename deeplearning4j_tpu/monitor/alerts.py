@@ -612,6 +612,36 @@ def default_rules() -> List[Rule]:
     ]
 
 
+def fleet_rules(slo_p99_ms: float = 100.0,
+                queue_high: float = 32.0) -> List[Rule]:
+    """The serving fleet's elastic-scaling triggers, evaluated by the
+    router's own private engine (never the process-global one — a
+    scale signal must not trip a co-resident trainer's deploy gate).
+
+    Scale OUT when either pressure signal holds: the router-observed
+    windowed p99 breaches the SLO, or the summed worker queue depth
+    exceeds ``queue_high``.  Scale IN only after a long quiet stretch
+    (p99 comfortably under a quarter of the SLO), so the fleet never
+    flaps around the threshold."""
+    return [
+        Rule("fleet_scale_out_p99", "threshold", "fleet_router_p99_ms",
+             op=">", threshold=float(slo_p99_ms), for_intervals=2,
+             clear_intervals=2, severity="ticket",
+             description="fleet windowed p99 over the SLO: add a "
+                         "worker"),
+        Rule("fleet_scale_out_queue", "threshold", "fleet_queue_depth",
+             op=">", threshold=float(queue_high), for_intervals=2,
+             clear_intervals=2, severity="ticket",
+             description="summed fleet worker queue depth over the "
+                         "high-water mark: add a worker"),
+        Rule("fleet_scale_in", "threshold", "fleet_router_p99_ms",
+             op="<", threshold=float(slo_p99_ms) / 4.0,
+             for_intervals=8, clear_intervals=1, severity="ticket",
+             description="fleet p99 far under the SLO for a sustained "
+                         "window: drain a worker"),
+    ]
+
+
 _GLOBAL_LOCK = threading.Lock()
 _ENGINE: Optional[AlertEngine] = None
 

@@ -427,6 +427,12 @@ def main(argv: Optional[Sequence[str]] = None):
         return pod_driver_main(argv_list)
     if "--pod-worker" in argv_list or "--coordinator" in argv_list:
         return pod_worker_main(argv_list)
+    if "--fleet-worker" in argv_list:
+        # serving-fleet worker: same spawn shape as a pod worker (this
+        # module is the -m entrypoint), different payload — an
+        # InferenceEngine + UIServer behind the fleet router
+        from ..serving.fleet import fleet_worker_main
+        return fleet_worker_main(argv_list)
 
     from ..utils import model_serializer
     from ..utils.model_guesser import load_model_guess

@@ -7,21 +7,31 @@ native PJRT backends share.  Serving v2 adds ``ModelRegistry``
 (N named models LRU-paged under an HBM budget), ``SessionCache``
 (device-resident per-session RNN state, one dispatch per request),
 ``SloAdmissionController`` (p99-target load shedding) and the int8
-weight path in ``serving.quantize``.
+weight path in ``serving.quantize``.  The horizontal story lives in
+``serving.fleet`` (``FleetRouter``: consistent-hash session routing
+over K worker processes, health-driven respawn, elastic scaling) and
+``serving.compile_cache`` (the persistent on-disk XLA executable cache
+respawned workers warm from).
 """
 
 from .admission import SloAdmissionController
 from .bucketing import (BucketPolicy, assemble_batch, batch_ladder,
                         pad_rows, pad_time, time_mask)
+from .compile_cache import (enable as enable_compile_cache,
+                            stats as compile_cache_stats)
 from .engine import InferenceEngine, QueueFull, ServingError, SloShed
+from .fleet import FleetError, FleetRouter, HashRing
 from .quantize import (dequantize_host, dequantize_tree, quantize_leaf,
                        quantize_tree, tree_nbytes)
 from .registry import ModelRegistry, UnknownModel
 from .sessions import SessionCache, SessionError
 
-__all__ = ["BucketPolicy", "InferenceEngine", "ModelRegistry", "QueueFull",
+__all__ = ["BucketPolicy", "FleetError", "FleetRouter", "HashRing",
+           "InferenceEngine", "ModelRegistry", "QueueFull",
            "ServingError", "SessionCache", "SessionError",
            "SloAdmissionController", "SloShed", "UnknownModel",
-           "assemble_batch", "batch_ladder", "dequantize_host",
-           "dequantize_tree", "pad_rows", "pad_time", "quantize_leaf",
-           "quantize_tree", "time_mask", "tree_nbytes"]
+           "assemble_batch", "batch_ladder", "compile_cache_stats",
+           "dequantize_host", "dequantize_tree",
+           "enable_compile_cache", "pad_rows", "pad_time",
+           "quantize_leaf", "quantize_tree", "time_mask",
+           "tree_nbytes"]

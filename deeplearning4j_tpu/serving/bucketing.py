@@ -65,6 +65,14 @@ class BucketPolicy:
         if any(t < 1 for t in self.timestep_buckets):
             raise ValueError("timestep buckets must be >= 1")
 
+    def describe(self) -> str:
+        """Stable one-line identity of the ladder — feeds the
+        executable-cache namespace key (``compile_cache.signature``),
+        so two processes agree on a namespace iff their ladders
+        match."""
+        return (f"serving-buckets:b{list(self.batch_buckets)}"
+                f":t{list(self.timestep_buckets)}")
+
     def batch_bucket(self, n_rows: int) -> int:
         """Smallest ladder entry >= ``n_rows``."""
         if n_rows < 1:

@@ -1,0 +1,150 @@
+"""Persistent on-disk XLA executable cache for serving workers.
+
+Cold-start is the enemy of elasticity: a respawned fleet worker that
+has to re-AOT-compile its whole bucket ladder (one executable per
+(input combo, batch bucket[, timestep bucket])) spends seconds in XLA
+before its first reply, which turns every health-driven respawn and
+every scale-out decision into a latency cliff.  This module wires the
+engine's bucket compiles through JAX's persistent compilation cache so
+the *second* process to compile any given (model, backend, bucket
+policy) ladder deserializes executables from disk instead of running
+XLA again.
+
+Key discipline — the part JAX does not do for us:
+
+- The cache *entry* key is JAX's own (computation, compile options,
+  backend) digest; nothing to add there.
+- The cache *directory* is namespaced by the autotuner's model
+  signature (:func:`tools.autotune.model_signature` — architecture +
+  backend + policy), so unrelated models never share a namespace and
+  a fleet can prewarm/ship one model's ladder as a unit.
+- JAX's cache key covers the compile options; flipping any
+  cache-relevant knob silently forks the namespace and every lookup
+  misses.  :func:`enable` therefore pins the full knob set
+  (min-entry-size, min-compile-time) to fixed values so every worker
+  process computes identical entry keys.
+
+``enable`` is idempotent and process-global (JAX has exactly one cache
+dir per process); workers call it FIRST, before building the model, so
+even the placement/canonicalization compiles hit the cache.
+
+Env: ``DL4J_TPU_FLEET_COMPILE_CACHE`` — cache root directory; the
+no-arg :func:`enable` uses it, and an empty/unset value disables the
+cache (cold compiles, the pre-fleet behavior).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+from .. import monitor as _monitor
+
+ENV_CACHE_DIR = "DL4J_TPU_FLEET_COMPILE_CACHE"
+
+#: the knob set pinned by :func:`enable`; every process that wants
+#: cache HITS (not just writes) must use these exact values, because
+#: they feed JAX's entry key.
+_PINNED_CONFIG = {
+    "jax_persistent_cache_min_entry_size_bytes": -1,
+    "jax_persistent_cache_min_compile_time_secs": 0.0,
+}
+
+_enabled_dir: Optional[str] = None
+
+
+def signature(conf, policy) -> str:
+    """The cache-namespace key for (model conf, bucket policy): the
+    autotuner's model signature when ``tools`` ships alongside the
+    package, else the same recipe computed locally (stripped
+    deployments must produce identical keys or a mixed fleet would
+    never share a namespace)."""
+    try:
+        from tools.autotune import model_signature
+        return model_signature(conf, policy)
+    except ImportError:
+        try:
+            conf_txt = conf.to_json(indent=None)
+        except Exception:
+            conf_txt = repr(conf)
+        import jax
+        payload = "|".join((conf_txt, jax.default_backend(),
+                            policy.describe()))
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def cache_dir_for(root: str, sig: str) -> str:
+    """The per-model-signature namespace directory under ``root``."""
+    return os.path.join(root, f"sig-{sig}")
+
+
+def enable(root: Optional[str] = None,
+           sig: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at
+    ``<root>/sig-<sig>`` (or ``<root>`` when ``sig`` is None) and pin
+    the cache-relevant config knobs.  ``root=None`` reads
+    ``DL4J_TPU_FLEET_COMPILE_CACHE``; unset/empty means "no cache" and
+    returns None.  Idempotent; re-enabling with a different directory
+    repoints the process (JAX holds one cache dir at a time).
+
+    Returns the active cache directory (created if missing)."""
+    global _enabled_dir
+    if root is None:
+        root = os.environ.get(ENV_CACHE_DIR, "").strip() or None
+    if not root:
+        return None
+    path = cache_dir_for(root, sig) if sig else root
+    import jax
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, value in _PINNED_CONFIG.items():
+        jax.config.update(knob, value)
+    _enabled_dir = path
+    _observe(path)
+    return path
+
+
+def disable() -> None:
+    """Detach the process from the persistent cache (tests)."""
+    global _enabled_dir
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    _enabled_dir = None
+
+
+def enabled_dir() -> Optional[str]:
+    """The directory :func:`enable` last activated (None = cold)."""
+    return _enabled_dir
+
+
+def stats(path: Optional[str] = None) -> dict:
+    """``{"dir", "entries", "bytes"}`` for ``path`` (default: the
+    enabled directory).  Entries are JAX ``*-cache`` files — the
+    serialized executables, not the access-time sidecars."""
+    path = path or _enabled_dir
+    if not path or not os.path.isdir(path):
+        return {"dir": path, "entries": 0, "bytes": 0}
+    entries = n_bytes = 0
+    for base, _dirs, files in os.walk(path):
+        for name in files:
+            if name.endswith("-atime"):
+                continue
+            entries += 1
+            try:
+                n_bytes += os.path.getsize(os.path.join(base, name))
+            except OSError:
+                pass
+    return {"dir": path, "entries": entries, "bytes": n_bytes}
+
+
+def _observe(path: str) -> None:
+    snap = stats(path)
+    _monitor.gauge(
+        "fleet_compile_cache_entries",
+        "serialized executables in the persistent compile cache").set(
+        snap["entries"])
+    _monitor.gauge(
+        "fleet_compile_cache_bytes",
+        "bytes of serialized executables in the persistent compile "
+        "cache").set(snap["bytes"])

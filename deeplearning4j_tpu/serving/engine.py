@@ -373,15 +373,27 @@ class InferenceEngine:
             return 0.0
         return (len(done) - 1) / span
 
-    def retry_after_s(self) -> float:
-        """Suggested client wait before retrying a rejected request:
-        current queue depth over the observed drain rate, clamped to
-        [1, 60] s (the 429 ``Retry-After`` header value)."""
-        rate = self.drain_rate()
-        depth = max(1, self._queue.qsize())
+    @staticmethod
+    def _retry_after(depth: int, rate: float) -> float:
+        """Pure Retry-After math over pre-snapshotted inputs: queue
+        depth over drain rate, clamped to [1, 60] s.  Static and
+        argument-only so rejection paths can snapshot ``depth``/
+        ``rate`` wherever is lock-safe and keep the computation itself
+        free of queue/deque reads (lint rule R3)."""
+        depth = max(1, int(depth))
         if rate <= 0:
             return 1.0
         return float(min(60.0, max(1.0, math.ceil(depth / rate))))
+
+    def retry_after_s(self) -> float:
+        """Suggested client wait before retrying a rejected request
+        (the 429 ``Retry-After`` header value).  The drain-rate read
+        comes FIRST — it only walks the completion deque — and the
+        queue's own mutex is taken last and alone (``qsize()``), so
+        this stays callable from rejection paths without ever nesting
+        the queue mutex under another lock."""
+        rate = self.drain_rate()
+        return self._retry_after(self._queue.qsize(), rate)
 
     # ------------------------------------------------------------- submit
     def predict(self, features, timeout: Optional[float] = None,
@@ -415,6 +427,12 @@ class InferenceEngine:
         try:
             self._queue.put(req, block=block, timeout=timeout)
         except queue.Full:
+            # Retry-After inputs are snapshotted here, after put()
+            # has released the queue's internals: the drain-rate walk
+            # must never run with the queue mutex pinned, and qsize()
+            # is the only call that briefly re-takes it (R3).
+            rate = self.drain_rate()
+            depth = self._queue.qsize()
             _monitor.counter("serving_rejected_total",
                              "requests rejected at queue capacity").inc(
                 engine=self._name)
@@ -425,7 +443,8 @@ class InferenceEngine:
             raise QueueFull(
                 f"serving queue at capacity "
                 f"({self._queue.maxsize}); retry or raise "
-                f"queue_capacity", self.retry_after_s()) from None
+                f"queue_capacity",
+                self._retry_after(depth, rate)) from None
         _monitor.counter("serving_requests_total",
                          "requests admitted to the serving queue").inc(
             engine=self._name)
@@ -693,6 +712,29 @@ class InferenceEngine:
         v = self.stage_weights(params, net_state=net_state,
                                version=version)
         return self.promote(v)
+
+    def warm_from_store(self, store, version: Optional[int] = None
+                        ) -> Optional[int]:
+        """Hydrate this engine's weights from a
+        :class:`~deeplearning4j_tpu.deploy.store.VersionedWeightStore`
+        snapshot (default: the latest) — the fleet worker's boot path,
+        making the store the single source of truth for what a fresh
+        process serves.  The store's monotonic stamp becomes the
+        engine's active version when it is newer than anything staged;
+        an empty store is a no-op (the init weights serve).  Returns
+        the store version now active, or None."""
+        from ..deploy.store import tree_from_flat
+        if version is None:
+            version = store.latest()
+        if version is None:
+            return None
+        snap = store.load(int(version))
+        params = tree_from_flat(self._model, snap.flat)
+        if snap.version > self._max_version_seen:
+            self.swap_weights(params, version=snap.version)
+        else:
+            self.swap_weights(params)
+        return snap.version
 
     def _retire_locked(self, version: int) -> None:
         """Drop ``version`` from the servable set; its host tree is

@@ -385,6 +385,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.models_data())
         elif path == "/deploy":
             self._json(ui.deploy_data())
+        elif path == "/fleet":
+            self._json(ui.fleet_data())
         else:
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
@@ -417,6 +419,15 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(length).decode())
         except Exception as e:
             self._send(400, json.dumps({"error": repr(e)}).encode())
+            return
+        fleet = ui.get_fleet()
+        if fleet is not None:
+            # front-door mode: the fleet router owns placement —
+            # session affinity, failover, and backpressure statuses
+            # all come back from the chosen worker verbatim
+            code, body, headers = fleet.handle_predict(payload)
+            self._send(code, json.dumps(body).encode(),
+                       headers=headers or None)
             return
         registry = ui.get_registry()
         model = payload.get("model")
@@ -586,6 +597,7 @@ class UIServer:
         self._engines: dict = {}
         self._registry = None
         self._deployments: dict = {}
+        self._fleet = None
 
     def attach(self, storage: StatsStorage) -> "UIServer":
         self.storage = storage
@@ -624,6 +636,31 @@ class UIServer:
 
     def get_registry(self):
         return self._registry
+
+    # ---- fleet front door (POST /predict routed, GET /fleet) -------------
+    def attach_fleet(self, router) -> "UIServer":
+        """Make this server the fleet's front door: ``POST /predict``
+        consistent-hash-routes through the attached
+        :class:`~deeplearning4j_tpu.serving.fleet.FleetRouter` (taking
+        precedence over any local registry/engine), and ``GET /fleet``
+        reports membership, health, and scale events."""
+        self._fleet = router
+        return self
+
+    def detach_fleet(self) -> "UIServer":
+        self._fleet = None
+        return self
+
+    def get_fleet(self):
+        return self._fleet
+
+    def fleet_data(self) -> dict:
+        """``GET /fleet`` body (a stub when no router is attached)."""
+        if self._fleet is None:
+            return {"attached": False, "workers": []}
+        data = self._fleet.status()
+        data["attached"] = True
+        return data
 
     # ---- deployment control plane (POST /deploy/{model}) -----------------
     def attach_deployment(self, controller) -> "UIServer":

@@ -788,3 +788,57 @@ def test_serving_plus_param_server_smoke_stays_acyclic(monkeypatch):
     assert ("scaleout.tcp.dedup", "scaleout.server.chunk") in g.edges()
     g.assert_acyclic()
     lockgraph.reset()
+
+
+# --------------------------- R3: the retry-after recompute regression
+
+# The shape bench/serving must never reship: recomputing the
+# retry-after hint with a blocking queue op while HOLDING the queue
+# mutex (the drain thread needs that mutex to make the queue drain —
+# the hint computation would stall the very rate it reports).
+R3_RETRY_HOT = '''
+class Engine:
+    def reject(self, item):
+        with self._queue_lock:
+            depth = self._queue.qsize()
+            self._queue.put(item, timeout=0.5)
+            return min(60.0, max(1.0, depth / self.drain_rate()))
+'''
+
+# The shipped shape: drain-rate and depth snapshotted with NO lock
+# held; the arithmetic is pure.
+R3_RETRY_CLEAN = '''
+class Engine:
+    @staticmethod
+    def _retry_after(depth, rate):
+        if rate <= 0.0:
+            return 1.0
+        return float(min(60.0, max(1.0, depth / rate)))
+
+    def retry_after_s(self):
+        rate = self.drain_rate()
+        depth = self._queue.qsize()
+        return self._retry_after(depth, rate)
+'''
+
+
+def test_r3_retry_after_recompute_under_queue_lock_trips():
+    fs = lint_source(R3_RETRY_HOT, "fx.py", rules={"R3"})
+    assert _rules(fs) == ["R3"]
+    assert any("put" in f.message for f in fs)
+
+
+def test_r3_retry_after_snapshot_shape_is_clean():
+    assert lint_source(R3_RETRY_CLEAN, "fx.py", rules={"R3"}) == []
+
+
+def test_r3_shipped_serving_sources_are_clean():
+    """The real ``serving.engine`` + ``serving.fleet`` sources pass R3:
+    every blocking call in the hot paths happens outside lock scopes
+    (or carries an audited suppression)."""
+    for rel in ("deeplearning4j_tpu/serving/engine.py",
+                "deeplearning4j_tpu/serving/fleet.py"):
+        path = os.path.join(REPO_ROOT, rel)
+        with open(path) as fh:
+            src = fh.read()
+        assert lint_source(src, rel, rules={"R3"}) == [], rel

@@ -1,0 +1,84 @@
+"""Perf-regression-watch tests (``tools/perfwatch.py``): round loading
+(headline + the sparse fleet series), the trailing-median throughput
+gates, and the exit-code contract."""
+
+import json
+import os
+
+from tools import perfwatch
+
+
+def _round(tmp_path, n, parsed, rc=0):
+    doc = {"n": n, "cmd": "bench", "rc": rc, "parsed": parsed}
+    path = os.path.join(str(tmp_path), f"BENCH_r{n:02d}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+
+
+def _lenet(value, **extra):
+    return {"metric": "lenet_mnist_train_samples_per_sec_per_chip",
+            "value": value, "unit": "samples/sec/chip", **extra}
+
+
+def test_load_rounds_reads_fleet_series_both_ways(tmp_path):
+    # as the headline metric of a --fleet round ...
+    _round(tmp_path, 1, {"metric": "fleet_requests_per_sec",
+                         "value": 120.0, "unit": "requests/sec"})
+    # ... and as an extra field on a normal round
+    _round(tmp_path, 2, _lenet(1000.0, fleet_requests_per_sec=130.0))
+    # ... and absent entirely
+    _round(tmp_path, 3, _lenet(1001.0))
+    rounds = perfwatch.load_rounds(str(tmp_path))
+    assert [r["fleet_requests_per_sec"] for r in rounds] == \
+        [120.0, 130.0, None]
+
+
+def test_fleet_gate_trips_on_drop(tmp_path):
+    for n, rps in enumerate((100.0, 110.0, 105.0, 60.0), start=1):
+        _round(tmp_path, n, {"metric": "fleet_requests_per_sec",
+                             "value": rps, "unit": "requests/sec"})
+    rounds = perfwatch.load_rounds(str(tmp_path))
+    findings = perfwatch.check_fleet_throughput(rounds, 0.10, 4)
+    assert len(findings) == 1
+    assert findings[0].check == "fleet-throughput"
+    assert "60.0" in findings[0].message
+
+
+def test_fleet_gate_clean_within_tolerance_and_skips_failed(tmp_path):
+    _round(tmp_path, 1, {"metric": "fleet_requests_per_sec",
+                         "value": 100.0})
+    _round(tmp_path, 2, {"metric": "fleet_requests_per_sec",
+                         "value": 1.0}, rc=1)      # failed run: ignored
+    _round(tmp_path, 3, {"metric": "fleet_requests_per_sec",
+                         "value": 95.0})
+    rounds = perfwatch.load_rounds(str(tmp_path))
+    assert perfwatch.check_fleet_throughput(rounds, 0.10, 4) == []
+
+
+def test_fleet_gate_needs_two_fleet_rounds(tmp_path):
+    _round(tmp_path, 1, _lenet(1000.0))
+    _round(tmp_path, 2, {"metric": "fleet_requests_per_sec",
+                         "value": 50.0})
+    rounds = perfwatch.load_rounds(str(tmp_path))
+    assert perfwatch.check_fleet_throughput(rounds, 0.10, 4) == []
+
+
+def test_main_exit_codes_and_report(tmp_path):
+    for n, rps in enumerate((100.0, 101.0, 99.0, 40.0), start=1):
+        _round(tmp_path, n, {"metric": "fleet_requests_per_sec",
+                             "value": rps})
+    report = str(tmp_path / "PERF_REPORT.md")
+    rc = perfwatch.main(["--root", str(tmp_path), "--report", report])
+    assert rc == perfwatch.EXIT_FINDINGS
+    text = open(report).read()
+    assert "fleet req/s" in text and "**FAIL**" in text
+
+    # repair the head round: gate goes green, exit 0
+    _round(tmp_path, 4, {"metric": "fleet_requests_per_sec",
+                         "value": 98.0})
+    rc = perfwatch.main(["--root", str(tmp_path), "--no-report"])
+    assert rc == perfwatch.EXIT_CLEAN
+
+    assert perfwatch.main(["--root", str(tmp_path / "nope"),
+                           "--no-report"]) == \
+        perfwatch.EXIT_INTERNAL_ERROR

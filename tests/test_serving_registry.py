@@ -312,3 +312,121 @@ def test_registry_swap_weights_keeps_budget_accounting():
         assert eng.model_bytes() == eng.resident_bytes()
     finally:
         reg.stop_all()
+
+
+# ---- concurrent paging races ---------------------------------------------
+
+def test_engine_concurrent_ensure_resident_single_copy():
+    """Two (here: six) threads racing ``ensure_resident`` on a
+    paged-out engine must land exactly ONE device copy — resident
+    bytes equal one model, never a multiple."""
+    import threading
+    eng = _engine(91)
+    try:
+        per = eng.model_bytes()
+        eng.ensure_resident()
+        eng.release_device_buffers()
+        assert not eng.is_resident()
+        gate = threading.Barrier(6)
+        errs = []
+
+        def page():
+            try:
+                gate.wait(10)
+                eng.ensure_resident()
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=page) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs
+        assert eng.is_resident()
+        assert eng.resident_bytes() == per
+    finally:
+        eng.stop()
+
+
+def test_registry_concurrent_page_in_same_model_under_budget():
+    """Eight threads hammering the same paged-out model under a tight
+    budget: the registry may only ever hold one resident copy of it
+    (no double-counted bytes), the budget holds throughout the race,
+    and no request errors."""
+    import threading
+    probe = _engine(90)
+    per = probe.model_bytes()
+    probe.stop()
+    budget = 2 * per + per // 2
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        for s in (1, 2, 3):
+            reg.register(f"m{s}", _engine(s))
+        assert not reg.stats()["models"]["m1"]["resident"]  # the LRU
+        gate = threading.Barrier(8)
+        errs = []
+        x = np.zeros((1, 4), np.float32)
+
+        def hit():
+            try:
+                gate.wait(10)
+                for _ in range(5):
+                    reg.predict("m1", x, timeout=60.0)
+                    assert reg.resident_bytes() <= budget
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs
+        eng = reg.get("m1")
+        assert eng.is_resident()
+        assert eng.resident_bytes() == per          # exactly one copy
+        assert reg.resident_bytes() <= budget
+    finally:
+        reg.stop_all()
+
+
+def test_registry_concurrent_pressure_never_evicts_pinned():
+    """Concurrent traffic to two unpinned models under a budget that
+    fits ~1.5 models must page them against each other — and never
+    touch the pinned tenant, whose eviction counter stays at zero."""
+    import threading
+    probe = _engine(89)
+    per = probe.model_bytes()
+    probe.stop()
+    reg = ModelRegistry(hbm_budget_bytes=2 * per + per // 2)
+    try:
+        reg.register("keep", _engine(1, name="keep"), pinned=True)
+        reg.register("b", _engine(2, name="b"))
+        reg.register("c", _engine(3, name="c"))
+        gate = threading.Barrier(8)
+        errs = []
+        x = np.zeros((1, 4), np.float32)
+
+        def churn(i):
+            name = "b" if i % 2 else "c"
+            try:
+                gate.wait(10)
+                for _ in range(4):
+                    reg.predict(name, x, timeout=60.0)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=churn, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs
+        assert reg.stats()["models"]["keep"]["resident"]
+        vals = monitor.snapshot().get("serving_model_evictions_total",
+                                      {}).get("values", {})
+        assert not any("keep" in str(k) for k in vals)
+    finally:
+        reg.stop_all()

@@ -19,6 +19,11 @@ Checks (each failed check is one finding):
   must not grow more than ``--bytes-tolerance`` (default 10%) over the
   smallest value in the history: memory per step creeping up is a
   regression even when throughput holds.
+- **fleet throughput drop** — rounds whose parsed line carries
+  ``fleet_requests_per_sec`` (the ``bench.py --fleet`` admitted
+  open-loop rate, either as the headline metric or as an extra field)
+  form their own series, gated the same way as the headline: newest
+  vs the trailing median, ``--tolerance`` fractional drop.
 
 Output: findings on stdout (``--json`` for machine-readable) and a
 ``PERF_REPORT.md`` snapshot of the trajectory + verdicts (suppress with
@@ -74,6 +79,10 @@ def load_rounds(root: str) -> list:
         with open(path) as fh:
             doc = json.load(fh)
         parsed = doc.get("parsed") or {}
+        fleet_rps = parsed.get("fleet_requests_per_sec")
+        if fleet_rps is None \
+                and parsed.get("metric") == "fleet_requests_per_sec":
+            fleet_rps = parsed.get("value")
         rounds.append({
             "round": int(doc.get("n", m.group(1))),
             "file": os.path.basename(path),
@@ -83,6 +92,7 @@ def load_rounds(root: str) -> list:
             "unit": parsed.get("unit"),
             "batch": parsed.get("batch"),
             "hbm_bytes_per_step": parsed.get("hbm_bytes_per_step"),
+            "fleet_requests_per_sec": fleet_rps,
         })
     rounds.sort(key=lambda r: r["round"])
     return rounds
@@ -123,6 +133,36 @@ def check_throughput(rounds: list, tolerance: float,
     return []
 
 
+def check_fleet_throughput(rounds: list, tolerance: float,
+                           trailing: int) -> list:
+    """Newest fleet admitted-throughput round vs its trailing median.
+
+    The fleet series is sparser than the headline (only rounds where
+    the driver ran ``bench.py --fleet`` carry it), so it gets its own
+    check rather than riding the headline-metric match."""
+    usable = [r for r in rounds
+              if r["fleet_requests_per_sec"] is not None
+              and r["rc"] == 0]
+    if len(usable) < 2:
+        return []
+    head = usable[-1]
+    prior = [r["fleet_requests_per_sec"] for r in usable[:-1]][-trailing:]
+    base = statistics.median(prior)
+    if base <= 0:
+        return []
+    drop = (base - head["fleet_requests_per_sec"]) / base
+    head["fleet_drop_vs_trailing"] = round(drop, 4)
+    if drop > tolerance:
+        return [Finding(
+            "fleet-throughput",
+            f"{head['file']}: fleet_requests_per_sec = "
+            f"{head['fleet_requests_per_sec']:.1f} is "
+            f"{drop * 100:.1f}% below the trailing median {base:.1f} "
+            f"of the previous {len(prior)} fleet round(s) "
+            f"(tolerance {tolerance * 100:.0f}%)")]
+    return []
+
+
 def check_bytes(rounds: list, tolerance: float) -> list:
     """Newest recorded hbm_bytes_per_step vs the history minimum."""
     series = [(r["file"], r["hbm_bytes_per_step"]) for r in rounds
@@ -159,16 +199,19 @@ def write_report(path: str, rounds: list, findings: list,
         "",
         "## Trajectory",
         "",
-        "| round | metric | value | batch | hbm bytes/step | rc |",
-        "|---|---|---|---|---|---|",
+        "| round | metric | value | batch | hbm bytes/step "
+        "| fleet req/s | rc |",
+        "|---|---|---|---|---|---|---|",
     ]
     for r in rounds:
         value = "-" if r["value"] is None else f"{r['value']:.1f}"
         hbm = ("-" if r["hbm_bytes_per_step"] is None
                else f"{r['hbm_bytes_per_step']:.0f}")
+        fleet = ("-" if r.get("fleet_requests_per_sec") is None
+                 else f"{r['fleet_requests_per_sec']:.1f}")
         lines.append(
             f"| r{r['round']:02d} | {r['metric'] or '-'} | {value} "
-            f"| {r['batch'] or '-'} | {hbm} | {r['rc']} |")
+            f"| {r['batch'] or '-'} | {hbm} | {fleet} | {r['rc']} |")
     lines += ["", "## Verdict", ""]
     if findings:
         lines += [f"- **FAIL** {f}" for f in findings]
@@ -194,6 +237,8 @@ def run(root: str, args) -> list:
     rounds = load_rounds(root)
     findings = []
     findings += check_throughput(rounds, args.tolerance, args.trailing)
+    findings += check_fleet_throughput(rounds, args.tolerance,
+                                       args.trailing)
     findings += check_bytes(rounds, args.bytes_tolerance)
     if not args.no_report:
         write_report(args.report or os.path.join(root, "PERF_REPORT.md"),
