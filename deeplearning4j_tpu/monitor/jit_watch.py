@@ -124,11 +124,41 @@ def publish_cost_analysis(name: str, obj: Any) -> None:
         pass
 
 
+# Signature construction is on the dispatch hot path (every watched
+# call, even steady-state cache hits), and ``str(treedef)`` on a
+# params-sized pytree costs ~100µs — more than the jitted dispatch it
+# wraps for single-token decode.  Treedefs and (dtype, shape) pairs are
+# hashable and few, so both stringifications are memoised; a serving
+# loop at a warm signature pays only dict lookups.  Bounded clears keep
+# a pathological shape churn from growing the memos without bound.
+_TREEDEF_STRS: dict = {}
+_LEAF_DESCS: dict = {}
+_MEMO_LIMIT = 4096
+
+
+def _treedef_str(treedef) -> str:
+    s = _TREEDEF_STRS.get(treedef)
+    if s is None:
+        if len(_TREEDEF_STRS) >= _MEMO_LIMIT:
+            _TREEDEF_STRS.clear()
+        s = _TREEDEF_STRS[treedef] = str(treedef)
+    return s
+
+
 def _leaf_desc(leaf: Any) -> str:
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
-        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+        try:
+            desc = _LEAF_DESCS.get((dtype, shape))
+        except TypeError:  # unhashable exotic dtype/shape: build direct
+            return f"{dtype}[{','.join(str(d) for d in shape)}]"
+        if desc is None:
+            if len(_LEAF_DESCS) >= _MEMO_LIMIT:
+                _LEAF_DESCS.clear()
+            desc = f"{dtype}[{','.join(str(d) for d in shape)}]"
+            _LEAF_DESCS[(dtype, shape)] = desc
+        return desc
     # Weak-typed python scalars: value changes do not retrace.
     if isinstance(leaf, bool):
         return "bool[]"
@@ -153,11 +183,11 @@ def abstract_signature(args: Tuple, kwargs: dict,
         else:
             leaves, treedef = jax.tree_util.tree_flatten(arg)
             descs = ",".join(_leaf_desc(l) for l in leaves)
-            parts.append(f"{treedef}:{descs}")
+            parts.append(f"{_treedef_str(treedef)}:{descs}")
     for k in sorted(kwargs):
         leaves, treedef = jax.tree_util.tree_flatten(kwargs[k])
         descs = ",".join(_leaf_desc(l) for l in leaves)
-        parts.append(f"{k}={treedef}:{descs}")
+        parts.append(f"{k}={_treedef_str(treedef)}:{descs}")
     return "; ".join(parts)
 
 

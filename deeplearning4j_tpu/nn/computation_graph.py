@@ -71,6 +71,7 @@ class ComputationGraph:
         self._pretrain_done = False
         self._rnn_carries: Optional[Dict[str, Any]] = None
         self._rnn_carry_batch = -1
+        self._decode_grow_cache: Dict[int, Any] = {}
         self._precision: Optional[_precision.PrecisionPolicy] = None
 
     def _pol(self) -> _precision.PrecisionPolicy:
@@ -180,11 +181,17 @@ class ComputationGraph:
                     out = layer.pre_output(params[name], x)
                 elif (pol.downcasts_output and name in conf.network_outputs
                       and hasattr(layer, "pre_output")
-                      and hasattr(layer, "_activate")
-                      and not (carries is not None and name in carries)):
+                      and hasattr(layer, "_activate")):
                     # fp32 logits contract, head half: output-head logits
                     # are cast fp32 BEFORE softmax/sigmoid so serving
                     # probabilities are fp32-exact, not bf16-rounded.
+                    # Applies even when the vertex is in ``carries``
+                    # (rnn_step / decode_step): the only recurrent head
+                    # with pre_output is RnnOutputLayer, whose carry is
+                    # () — forward_seq would be the same math minus the
+                    # fp32 cast, and skipping it must not change the
+                    # carry.  Without this, N single-token decode calls
+                    # drift from output() under mixed_bf16.
                     x = layer.apply_dropout(x, train, key_of[name])
                     out = layer._activate(
                         layer.pre_output(params[name], x)
@@ -712,6 +719,39 @@ class ComputationGraph:
         return _monitor.watched_jit(run, name="cg.advance")
 
     @functools.cached_property
+    def _decode_step_fn(self):
+        """Autoregressive decode step: the ``cg.advance`` contract over
+        generalized state trees (RNN carries AND KV-cache rings), under
+        its own jit name so the serving sanitizer can budget
+        ``serving.decode_step`` separately (one dispatch per token)."""
+        def run(params, net_state, carries, features):
+            acts, _, new_carries = self._forward(
+                params, net_state, features, train=False, rng=None,
+                carries=carries)
+            return ([acts[o] for o in self.conf.network_outputs],
+                    new_carries)
+        return _monitor.watched_jit(run, name="cg.decode_step")
+
+    def _decode_grow_fn(self, cache_len: int):
+        """Jitted state-tree growth to a larger KV ring capacity — ONE
+        dispatch per (shape, target) pair (the serving bucket hop)."""
+        from .layers.recurrent import BaseRecurrentLayer
+        if cache_len not in self._decode_grow_cache:
+            def grow(carries):
+                out = {}
+                for n, c in carries.items():
+                    layer = self.vertices[n].layer
+                    if (isinstance(layer, BaseRecurrentLayer)
+                            and getattr(layer, "HAS_KV_RING", False)):
+                        out[n] = layer.grow_carry(c, cache_len)
+                    else:
+                        out[n] = c
+                return out
+            self._decode_grow_cache[cache_len] = _monitor.watched_jit(
+                grow, name="cg.decode_grow")
+        return self._decode_grow_cache[cache_len]
+
+    @functools.cached_property
     def _output_fn(self):
         def run(params, net_state, features, features_masks):
             input_masks = None
@@ -1136,10 +1176,35 @@ class ComputationGraph:
                     f"support {what}: its backward pass needs the full "
                     "sequence")
 
-    def _init_carries(self, batch: int) -> Dict[str, Any]:
+    def _init_carries(self, batch: int,
+                      cache_len: Optional[int] = None) -> Dict[str, Any]:
+        """Zero carries per recurrent vertex; ``cache_len`` overrides
+        KV-ring capacities (the serving (batch, cache_len) bucket
+        ladder) and is ignored by RNN carries."""
         dtype = jnp.dtype(self._pol().compute_dtype)
-        return {n: self.vertices[n].layer.init_carry(batch, dtype)
-                for n in self._recurrent_vertex_names()}
+        out = {}
+        for n in self._recurrent_vertex_names():
+            layer = self.vertices[n].layer
+            if cache_len is not None and getattr(layer, "HAS_KV_RING",
+                                                 False):
+                out[n] = layer.init_carry(batch, dtype,
+                                          cache_len=cache_len)
+            else:
+                out[n] = layer.init_carry(batch, dtype)
+        return out
+
+    def has_kv_ring(self) -> bool:
+        """Whether any vertex carries a KV-cache ring (selects the
+        ``serving.decode_step`` sanitizer scenario)."""
+        return any(getattr(self.vertices[n].layer, "HAS_KV_RING", False)
+                   for n in self._layer_names())
+
+    def max_cache_len(self) -> int:
+        """Largest KV-ring capacity across vertices (0 without rings)."""
+        return max((int(self.vertices[n].layer.cache_len)
+                    for n in self._layer_names()
+                    if getattr(self.vertices[n].layer, "HAS_KV_RING",
+                               False)), default=0)
 
     # --------------------------------------------- rnn streaming state API
     def rnn_time_step(self, *features):
@@ -1200,6 +1265,42 @@ class ComputationGraph:
             self.params if params is None else params,
             self.net_state if net_state is None else net_state,
             carries, xs, None)
+
+    def decode_step(self, carries, *features, params=None,
+                    net_state=None):
+        """Autoregressive decode step: :meth:`rnn_stateless_step`
+        generalized to arbitrary per-session state trees (RNN carries
+        and KV-cache rings) under the ``cg.decode_step`` jit name.
+        Returns ``(outs, new_carries)`` with ``outs`` a list (one per
+        graph output); N single-token calls BIT-match one full-sequence
+        ``output()`` with the fp32-logits contract intact.  Inputs must
+        be 3-D; ``carries=None`` starts a fresh state tree;
+        ``params``/``net_state`` pin a weight version (same shapes →
+        jit cache hit)."""
+        self.init()
+        self._require_carry_support("decode_step")
+        # jit commits np inputs itself; an eager device_put per token
+        # would dominate the single-token dispatch (bench.py --decode).
+        xs = tuple(f if hasattr(f, "ndim") else np.asarray(f)
+                   for f in features)
+        for x in xs:
+            if x.ndim != 3:
+                raise ValueError(
+                    f"decode_step expects (batch, time, features) "
+                    f"inputs, got shape {x.shape}")
+        if carries is None:
+            carries = self._init_carries(int(xs[0].shape[0]))
+        return self._decode_step_fn(
+            self.params if params is None else params,
+            self.net_state if net_state is None else net_state,
+            carries, xs)
+
+    def grow_decode_carries(self, carries, cache_len: int):
+        """Pad every KV ring in ``carries`` up to ``cache_len`` slots
+        (ONE jitted dispatch; non-ring carries pass through) — the
+        serving cache-len bucket hop."""
+        self.init()
+        return self._decode_grow_fn(int(cache_len))(carries)
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""

@@ -6,6 +6,7 @@ JSON round-trips work regardless of which layer module the user touched
 first.
 """
 
+from . import attention  # noqa: F401
 from . import base  # noqa: F401
 from . import convolution  # noqa: F401
 from . import core  # noqa: F401

@@ -1,0 +1,182 @@
+"""Causal decoder self-attention with a device-resident KV-cache ring.
+
+The transformer-decode serving tier (ROADMAP item 5): a causal
+multi-head self-attention layer whose *inference state* is a KV-cache
+ring — fixed-capacity ``(batch, heads, cache_len, head_dim)`` K/V
+buffers plus an int32 write cursor, updated in place via
+``lax.dynamic_update_slice`` inside the compiled step
+(``ops.attention.kv_ring_update``).  The ring is the layer's carry
+under the :class:`BaseRecurrentLayer` contract, so everything built for
+RNN streaming — ``rnn_time_step``, ``decode_step``,
+``serving.SessionCache`` — serves autoregressive decode unchanged:
+N single-token steps cost N single dispatches and BIT-match the
+full-sequence forward (``tests/test_decode.py``).
+
+Two forward tiers:
+
+- **training** (``train=True``): ``flash_attention(causal=True)`` — the
+  fused Pallas kernel, O(T·d) memory, fused backward; the ring never
+  materializes.
+- **inference** (``train=False`` / ``forward_seq``): the ring-dense
+  path ``ops.attention.kv_ring_attention`` with exact cursor masking.
+  Masked slots contribute exact zeros, so the result is bitwise
+  independent of ring capacity — the parity contract that lets decode
+  hop (batch, cache_len) buckets compile-free while still matching
+  ``output()`` to the last ulp.
+
+No positional encoding is built in: position information, when the
+model needs it, comes from the upstream embedding/preprocessor stack
+(the layer itself must stay position-free so the ring write at cursor
+``t`` is the only place position enters — one source of truth for the
+bit-parity proof).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.attention import (flash_attention, kv_ring_attention,
+                              kv_ring_update)
+from ..conf import serde
+from ..weights import init_weights
+from .base import Array, ParamTree
+from .recurrent import BaseRecurrentLayer
+
+
+@serde.register("causal_attention")
+@dataclasses.dataclass
+class CausalSelfAttention(BaseRecurrentLayer):
+    """Multi-head causal self-attention over (batch, time, features)
+    activations.
+
+    ``n_heads`` must divide ``n_out``; ``cache_len`` is the ring
+    capacity — the longest sequence the *inference* state can hold
+    (training is not bounded by it).  Params: Wq/Wk/Wv (n_in, n_out),
+    Wo (n_out, n_out), b (n_out,); the output projection applies the
+    layer activation (default identity, the transformer convention).
+
+    Carry: ``(k_cache, v_cache, cursor)`` with K/V of shape
+    (batch, n_heads, cache_len, head_dim) and an int32 scalar cursor =
+    tokens already written.  ``init_carry`` accepts a ``cache_len``
+    override so serving can ladder ring capacity per session
+    (``serving.sessions``); ``grow_carry`` pads a ring up to the next
+    bucket (masked slots are inert, so growth never changes results).
+    """
+
+    HAS_KV_RING = True
+
+    activation: str = "identity"
+    n_heads: int = 1
+    cache_len: int = 128
+
+    # ------------------------------------------------------------- params
+    def _head_dim(self) -> int:
+        if self.n_out <= 0 or self.n_heads <= 0 \
+                or self.n_out % self.n_heads:
+            raise ValueError(
+                f"n_heads={self.n_heads} must divide n_out={self.n_out}")
+        return self.n_out // self.n_heads
+
+    def param_order(self) -> tuple:
+        return ("Wq", "Wk", "Wv", "Wo", "b")
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> ParamTree:
+        self._head_dim()
+        kq, kk, kv, ko = jax.random.split(rng, 4)
+        wi = self.weight_init or "xavier"
+        return {
+            "Wq": init_weights(kq, (self.n_in, self.n_out), wi,
+                               self.dist, dtype),
+            "Wk": init_weights(kk, (self.n_in, self.n_out), wi,
+                               self.dist, dtype),
+            "Wv": init_weights(kv, (self.n_in, self.n_out), wi,
+                               self.dist, dtype),
+            "Wo": init_weights(ko, (self.n_out, self.n_out), wi,
+                               self.dist, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init or 0.0, dtype),
+        }
+
+    # -------------------------------------------------------------- carry
+    def init_carry(self, batch: int, dtype,
+                   cache_len: Optional[int] = None):
+        cap = int(cache_len if cache_len is not None else self.cache_len)
+        if cap < 1:
+            raise ValueError("cache_len must be >= 1")
+        shape = (batch, self.n_heads, cap, self._head_dim())
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                jnp.zeros((), jnp.int32))
+
+    def grow_carry(self, carry, cache_len: int):
+        """Zero-pad the ring's cache axis up to ``cache_len`` (cursor
+        unchanged) — the compile-free bucket hop.  Traceable: runs
+        inside the containers' jitted ``decode_grow`` step."""
+        k_cache, v_cache, cursor = carry
+        cap = k_cache.shape[2]
+        if cache_len < cap:
+            raise ValueError(
+                f"cannot shrink KV ring from {cap} to {cache_len}")
+        if cache_len == cap:
+            return carry
+        pad = [(0, 0), (0, 0), (0, cache_len - cap), (0, 0)]
+        return (jnp.pad(k_cache, pad), jnp.pad(v_cache, pad), cursor)
+
+    # ------------------------------------------------------------ forward
+    def _project(self, params: ParamTree, x: Array):
+        b, t = x.shape[0], x.shape[1]
+        h, dh = self.n_heads, self._head_dim()
+        q = (x @ params["Wq"]).reshape(b, t, h, dh)
+        k = (x @ params["Wk"]).reshape(b, t, h, dh)
+        v = (x @ params["Wv"]).reshape(b, t, h, dh)
+        return q, k, v
+
+    def _finish(self, params: ParamTree, ctx: Array, x: Array,
+                mask: Optional[Array]):
+        b, t = x.shape[0], x.shape[1]
+        out = self._activate(
+            ctx.reshape(b, t, self.n_out) @ params["Wo"] + params["b"])
+        if mask is not None:
+            # trailing time pad: causal queries never see later keys, so
+            # zeroing padded outputs is the whole masking story
+            out = out * mask[..., None].astype(out.dtype)
+        return out
+
+    def forward_seq(self, params: ParamTree, x: Array, carry, *,
+                    train: bool, rng=None, mask: Optional[Array] = None):
+        k_cache, v_cache, cursor = carry
+        t, cap = x.shape[1], k_cache.shape[2]
+        if t > cap:
+            raise ValueError(
+                f"chunk of {t} timesteps exceeds the KV ring capacity "
+                f"{cap}; raise cache_len (or let serving.sessions hop "
+                "buckets)")
+        x = self.apply_dropout(x, train, rng)
+        q, k, v = self._project(params, x)
+        k_cache, v_cache = kv_ring_update(
+            k_cache, v_cache, cursor,
+            jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+        ctx = kv_ring_attention(q, k_cache, v_cache, cursor)
+        out = self._finish(params, ctx, x, mask)
+        return out, (k_cache, v_cache,
+                     cursor + jnp.asarray(t, jnp.int32))
+
+    def forward(self, params: ParamTree, state, x: Array, *,
+                train: bool, rng=None, mask=None):
+        if train:
+            # training tier: fused flash kernel, no ring; gradients flow
+            # through the Pallas custom vjp
+            x = self.apply_dropout(x, train, rng)
+            q, k, v = self._project(params, x)
+            ctx = flash_attention(q, k, v, causal=True)
+            return self._finish(params, ctx, x, mask), state
+        # inference tier rides the SAME ring-dense math as decode (from
+        # a zero ring) — this is what makes N decode steps bit-match
+        # one full-sequence output() call
+        out, _ = self.forward_seq(
+            params, x, self.init_carry(x.shape[0], x.dtype,
+                                       max(self.cache_len, x.shape[1])),
+            train=False, rng=rng, mask=mask)
+        return out, state

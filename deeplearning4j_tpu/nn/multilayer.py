@@ -53,6 +53,7 @@ class MultiLayerNetwork:
         self._pretrain_step_cache: Dict[int, Any] = {}
         self._pretrain_done = False
         self._tbptt_step_cache: Dict[int, Any] = {}
+        self._decode_grow_cache: Dict[int, Any] = {}
         self._precision: Optional[_precision.PrecisionPolicy] = None
 
     def _pol(self) -> _precision.PrecisionPolicy:
@@ -151,11 +152,6 @@ class MultiLayerNetwork:
                 if layer.dropout and train:
                     x = layer.apply_dropout(x, train, keys[i])
                 x = layer.pre_output(params[i], x)
-            elif (carries is not None
-                  and isinstance(layer, BaseRecurrentLayer)):
-                x, new_carries[i] = layer.forward_seq(
-                    params[i], x, carries[i], train=train, rng=keys[i],
-                    mask=mask)
             elif (pol.downcasts_output and i == len(self.layers) - 1
                   and hasattr(layer, "pre_output")
                   and hasattr(layer, "_activate")):
@@ -163,9 +159,20 @@ class MultiLayerNetwork:
                 # logits are cast to fp32 BEFORE the softmax/sigmoid so
                 # serving probabilities are fp32-exact, not bf16-rounded
                 # (bf16 softmax row sums wobble at the 1e-3 level).
+                # Checked BEFORE the carries branch: a carried step
+                # (rnn_step / decode_step) must honor the same contract
+                # or N single-token calls drift from output() under
+                # mixed precision.  The only recurrent head with
+                # pre_output is RnnOutputLayer, whose carry is () — so
+                # skipping forward_seq leaves new_carries[i] correct.
                 x = layer.apply_dropout(x, train, keys[i])
                 x = layer._activate(
                     layer.pre_output(params[i], x).astype(jnp.float32))
+            elif (carries is not None
+                  and isinstance(layer, BaseRecurrentLayer)):
+                x, new_carries[i] = layer.forward_seq(
+                    params[i], x, carries[i], train=train, rng=keys[i],
+                    mask=mask)
             else:
                 x, new_state[i] = layer.forward(
                     params[i], net_state[i], x, train=train, rng=keys[i],
@@ -781,6 +788,36 @@ class MultiLayerNetwork:
             return out, new_carries
         return _monitor.watched_jit(run, name="mln.rnn_step")
 
+    @functools.cached_property
+    def _decode_step_fn(self):
+        """Autoregressive decode step: the ``rnn_step`` contract over
+        generalized state trees (RNN carries AND KV-cache rings), under
+        its own jit name so the serving sanitizer can budget
+        ``serving.decode_step`` separately (one dispatch per token)."""
+        def run(params, net_state, carries, features):
+            out, _, new_carries = self._forward(
+                params, net_state, features, train=False, rng=None,
+                carries=carries)
+            return out, new_carries
+        return _monitor.watched_jit(run, name="mln.decode_step")
+
+    def _decode_grow_fn(self, cache_len: int):
+        """Jitted state-tree growth to a larger KV ring capacity — ONE
+        dispatch per (shape, target) pair, cached like the tbptt steps,
+        so a serving bucket hop costs exactly one extra dispatch."""
+        from .layers.recurrent import BaseRecurrentLayer
+        if cache_len not in self._decode_grow_cache:
+            def grow(carries):
+                return [
+                    layer.grow_carry(carries[i], cache_len)
+                    if (isinstance(layer, BaseRecurrentLayer)
+                        and getattr(layer, "HAS_KV_RING", False))
+                    else carries[i]
+                    for i, layer in enumerate(self.layers)]
+            self._decode_grow_cache[cache_len] = _monitor.watched_jit(
+                grow, name="mln.decode_grow")
+        return self._decode_grow_cache[cache_len]
+
     # -------------------------------------------------------------- pretrain
     def _pretrain_step(self, i: int):
         """Jitted one-batch unsupervised step for layer ``i``: forward the
@@ -1122,13 +1159,36 @@ class MultiLayerNetwork:
                     f"Layer {i} ({type(layer).__name__}) does not support "
                     f"{what}: its backward pass needs the full sequence")
 
-    def _init_carries(self, batch: int):
-        """Zero recurrent carries, one entry per layer (() if stateless)."""
+    def _init_carries(self, batch: int, cache_len: Optional[int] = None):
+        """Zero recurrent carries, one entry per layer (() if stateless).
+        ``cache_len`` overrides KV-ring capacities (the serving
+        (batch, cache_len) bucket ladder); RNN carries ignore it."""
         from .layers.recurrent import BaseRecurrentLayer
         dtype = jnp.dtype(self._pol().compute_dtype)
-        return [layer.init_carry(batch, dtype)
-                if isinstance(layer, BaseRecurrentLayer) else ()
-                for layer in self.layers]
+        out = []
+        for layer in self.layers:
+            if not isinstance(layer, BaseRecurrentLayer):
+                out.append(())
+            elif cache_len is not None and getattr(layer, "HAS_KV_RING",
+                                                   False):
+                out.append(layer.init_carry(batch, dtype,
+                                            cache_len=cache_len))
+            else:
+                out.append(layer.init_carry(batch, dtype))
+        return out
+
+    def has_kv_ring(self) -> bool:
+        """Whether any layer carries a KV-cache ring (the decode-serving
+        state class — chooses the ``serving.decode_step`` sanitizer
+        scenario over ``serving.rnn_step``)."""
+        return any(getattr(layer, "HAS_KV_RING", False)
+                   for layer in self.layers)
+
+    def max_cache_len(self) -> int:
+        """Largest KV-ring capacity across layers (0 without rings) —
+        the top of the serving cache-len bucket ladder."""
+        return max((int(layer.cache_len) for layer in self.layers
+                    if getattr(layer, "HAS_KV_RING", False)), default=0)
 
     # ------------------------------------------------------------- inference
     def output(self, features, train: bool = False,
@@ -1247,6 +1307,45 @@ class MultiLayerNetwork:
             self.params if params is None else params,
             self.net_state if net_state is None else net_state,
             carries, x)
+
+    def decode_step(self, carries, features, params=None, net_state=None):
+        """Autoregressive decode step: :meth:`rnn_stateless_step`
+        generalized to arbitrary per-session state trees — RNN carries
+        and KV-cache rings alike — under the ``mln.decode_step`` jit
+        name.  Advance the state tree by the input timesteps and return
+        ``(out, new_carries)``; N single-token calls BIT-match one
+        full-sequence ``output()`` (fp32-logits contract included —
+        ``tests/test_decode.py``).  ``carries=None`` starts a fresh
+        state tree (ring capacity from the layers' ``cache_len``).
+        3-D ``(batch, time, n_in)`` features only; ``params``/
+        ``net_state`` override the weight operands for version-pinned
+        serving sessions (same shapes → jit cache hit, no recompile).
+        """
+        self.init()
+        self._require_carry_support("decode_step")
+        # No explicit jnp.asarray: jit commits np inputs itself, and an
+        # eager device_put of a single-token array costs more host time
+        # than the decode dispatch it feeds (bench.py --decode).
+        x = features if hasattr(features, "ndim") else np.asarray(features)
+        if x.ndim != 3:
+            raise ValueError(
+                f"decode_step expects (batch, time, features), got "
+                f"shape {x.shape}")
+        if carries is None:
+            carries = self._init_carries(int(x.shape[0]))
+        return self._decode_step_fn(
+            self.params if params is None else params,
+            self.net_state if net_state is None else net_state,
+            carries, x)
+
+    def grow_decode_carries(self, carries, cache_len: int):
+        """Pad every KV ring in ``carries`` up to ``cache_len`` slots
+        (ONE jitted dispatch; non-ring carries pass through) — the
+        serving cache-len bucket hop.  Ring slots beyond the cursor are
+        exact-zero under the cursor mask, so growth never changes
+        results."""
+        self.init()
+        return self._decode_grow_fn(int(cache_len))(carries)
 
     def rnn_clear_previous_state(self) -> None:
         """Reference ``rnnClearPreviousState()``."""

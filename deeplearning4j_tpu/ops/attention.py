@@ -603,3 +603,77 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
                            else _auto_block(T), T)
     return _flash_core(q, k, v, causal, scale, block_q, block_k,
                        bool(interpret), precision, bool(fused_backward))
+
+
+# --------------------------------------------------------------------------
+# KV-cache ring decode: the inference twin of flash_attention.
+#
+# Autoregressive serving keeps per-session K/V projections resident on
+# device in fixed-capacity (batch, heads, cache_len, d) buffers plus an
+# int32 write cursor; each decode step writes the new token's K/V at the
+# cursor via ``lax.dynamic_update_slice`` INSIDE the compiled program (the
+# cache never crosses the wire) and attends the new queries against the
+# whole ring with exact cursor masking.
+#
+# Parity contract (the bit-match the serving tests assert): slots at
+# positions > cursor + t are masked with ``_NEG_INF``; ``exp`` of those
+# scores underflows to EXACTLY 0.0, so masked slots contribute exact
+# additive/multiplicative zeros to the softmax denominator and the P·V
+# reduction.  Adding structural zeros never re-pairs the surviving terms
+# of a reduction, so the result is bitwise independent of the ring
+# capacity — decoding one token at a time against a 32-slot ring matches
+# the full-sequence forward against a 128-slot ring to the last ulp
+# (``tests/test_decode.py`` pins this at float64).
+
+
+def kv_ring_update(k_cache: Array, v_cache: Array, cursor,
+                   k_new: Array, v_new: Array):
+    """Write (batch, heads, T, d) new keys/values into the ring at the
+    cursor.  ``cursor`` may be a traced int32 scalar — the write happens
+    inside the compiled step, in place when XLA can alias the buffers.
+    Callers guarantee ``cursor + T <= cache_len`` (``dynamic_update_slice``
+    clamps out-of-range starts, which would silently overwrite the
+    newest history — ``serving.sessions`` hops to a larger bucket
+    first)."""
+    zero = jnp.zeros((), jnp.int32)
+    cursor = jnp.asarray(cursor, jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (zero, zero, cursor, zero))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (zero, zero, cursor, zero))
+    return k_cache, v_cache
+
+
+def kv_ring_attention(q: Array, k_cache: Array, v_cache: Array, cursor, *,
+                      sm_scale: Optional[float] = None) -> Array:
+    """Dense masked attention of (batch, T, heads, d) queries against a
+    (batch, heads, cache_len, d) KV ring whose slot ``c`` is visible to
+    query ``t`` iff ``c <= cursor + t`` (causality within the chunk plus
+    unwritten/stale-slot masking in one predicate).
+
+    Softmax runs in f32 (f64 under float64 inputs — the parity-test
+    dtype); the context comes back in the query dtype.  O(T·cache_len)
+    — the right tier for T=1 decode steps, where the score "matrix" is
+    a single row and flash tiling has nothing to save."""
+    if q.ndim != 4 or k_cache.ndim != 4:
+        raise ValueError(
+            f"kv_ring_attention wants (B,T,H,d) q and (B,H,C,d) cache, "
+            f"got q {q.shape}, k {k_cache.shape}")
+    scale = (float(sm_scale) if sm_scale is not None
+             else 1.0 / float(np.sqrt(q.shape[-1])))
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    cap = k_cache.shape[2]
+    t = q.shape[1]
+    cursor = jnp.asarray(cursor, jnp.int32)
+    # (B,T,H,d) x (B,H,C,d) -> (B,H,T,C), f32/f64 accumulation
+    s = jnp.einsum("bthd,bhcd->bhtc", q.astype(acc),
+                   k_cache.astype(acc)) * jnp.asarray(scale, acc)
+    valid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+             <= cursor + jnp.arange(t, dtype=jnp.int32)[:, None])
+    s = jnp.where(valid[None, None], s, jnp.asarray(_NEG_INF, acc))
+    # every query sees at least its own key, so the row max is finite
+    # and masked slots exp to exactly 0.0
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    ctx = jnp.einsum("bhtc,bhcd->bthd", p, v_cache.astype(acc))
+    return ctx.astype(q.dtype)

@@ -203,13 +203,21 @@ class InferenceEngine:
         self._quantize = quantize
         self._qjit = None
         self._qparams = None
+        self._qdecode = None
         if quantize == "int8":
             from . import quantize as _quant
             self._qparams, self._qspecs = _quant.quantize_tree(
                 model.params)
+            prefix = "cg" if self._is_graph else "mln"
             self._qjit = _quant.quantized_output_jit(
-                model, self._qspecs,
-                name=("cg" if self._is_graph else "mln") + ".output_int8")
+                model, self._qspecs, name=prefix + ".output_int8")
+            if getattr(model, "has_kv_ring", lambda: False)():
+                # int8 decode: same fused decode-inside-the-program
+                # contract as output_int8, handed to SessionCache as
+                # its step_fn override
+                self._qdecode = _quant.quantized_decode_jit(
+                    model, self._qspecs,
+                    name=prefix + ".decode_step_int8")
         self._runner = None
         if backend == "native":
             if self._policy.timestep_buckets:
@@ -460,20 +468,35 @@ class InferenceEngine:
         with self._session_lock:
             if self._sessions is None:
                 from .sessions import SessionCache
+                step_fn = None
+                if self._qdecode is not None:
+                    # int8 engines step sessions through the quantized
+                    # decode jit; hot-swap is forbidden for int8, so
+                    # the live qparams/net_state are closure constants
+                    qd, qp, ns = (self._qdecode, self._qparams,
+                                  self._model.net_state)
+                    if self._is_graph:
+                        def step_fn(carries, *feats, **_kw):
+                            return qd(qp, ns, carries, tuple(feats))
+                    else:
+                        def step_fn(carries, feats, **_kw):
+                            return qd(qp, ns, carries, feats)
                 self._sessions = SessionCache(
                     self._model, name=self._name,
                     version_fn=lambda: self._active_version,
                     weights_fn=self._weights_for_version,
+                    step_fn=step_fn,
                     **self._session_opts)
             return self._sessions
 
     def predict_session(self, session_id: str, features):
         """Streaming inference: advance ``session_id``'s device-resident
-        RNN state by the given timesteps (ONE dispatch) and return the
-        output.  Subject to the same SLO admission as ``predict``; not
-        queued/coalesced — session state is a chain, so each session
-        serializes its own steps while distinct sessions run
-        concurrently."""
+        state tree (RNN carries, or KV-cache rings for decode models) by
+        the given timesteps — ONE dispatch per step (per token for
+        decode) — and return the output.  Subject to the same SLO
+        admission as ``predict``; not queued/coalesced — session state
+        is a chain, so each session serializes its own steps while
+        distinct sessions run concurrently."""
         if not self._running:
             raise ServingError("engine not started (call start())")
         self._admit_or_shed()
@@ -521,6 +544,71 @@ class InferenceEngine:
                     if self._ensure_executable(widx, key):
                         n += 1
         return n
+
+    def warmup_decode(self, example_shape, chunk_lens=(1,)) -> int:
+        """Pre-compile the single-dispatch decode step across the
+        (batch, cache_len) bucket grid, plus the adjacent-bucket grow
+        transitions, so after warmup every session token and every
+        cache-len ladder hop is compile-free — the contract the armed
+        ``serving.decode_step`` sanitizer asserts.
+
+        ``example_shape`` is ONE token's feature shape (no batch/time
+        axes) — e.g. ``(n_in,)`` — or a tuple of such shapes for
+        multi-input graphs.  ``chunk_lens`` are the chunk lengths to
+        warm (the default ``(1,)`` is pure autoregressive decode).
+        Batches warm at the engine's batch-bucket ladder; sessions use
+        the request's exact batch size, so clients should send
+        ladder-sized batches (batch 1 is always on the ladder).  A hop
+        that SKIPS ladder buckets (a chunk larger than the next bucket)
+        still compiles once on first use.  Returns the number of fresh
+        compiles this call caused.
+        """
+        model = self._model
+        if not getattr(model, "has_kv_ring", lambda: False)():
+            raise ServingError(
+                "warmup_decode requires a model with KV-ring "
+                "(causal_attention) layers")
+        if self._is_graph and isinstance(example_shape, (list, tuple)) \
+                and example_shape and isinstance(example_shape[0],
+                                                 (list, tuple)):
+            shapes = [tuple(s) for s in example_shape]
+        else:
+            shapes = [tuple(example_shape)]
+        if len(shapes) != self._n_inputs:
+            raise ValueError(f"expected {self._n_inputs} example shapes, "
+                             f"got {len(shapes)}")
+        from .bucketing import batch_ladder
+        ladder = batch_ladder(model.max_cache_len())
+        prefix = "cg" if self._is_graph else "mln"
+        fns = ((prefix + ".decode_step_int8",) if self._qdecode is not None
+               else (prefix + ".decode_step",)) + (prefix + ".decode_grow",)
+
+        def _compiles() -> float:
+            c = _monitor.counter("jit_compiles_total", "")
+            return sum(c.value(fn=f) for f in fns)
+
+        n0 = _compiles()
+        for bb in self._policy.batch_buckets:
+            for t in chunk_lens:
+                t = int(t)
+                feats = tuple(np.zeros((bb, t) + shp, self._dtype)
+                              for shp in shapes)
+                for i, cap in enumerate(ladder):
+                    if t > cap:
+                        continue
+                    carries = model._init_carries(bb, cache_len=cap)
+                    if self._qdecode is not None:
+                        self._qdecode(self._qparams, model.net_state,
+                                      carries,
+                                      feats if self._is_graph
+                                      else feats[0])
+                    elif self._is_graph:
+                        model.decode_step(carries, *feats)
+                    else:
+                        model.decode_step(carries, feats[0])
+                    if i + 1 < len(ladder):
+                        model.grow_decode_carries(carries, ladder[i + 1])
+        return int(_compiles() - n0)
 
     # ------------------------------------------------------------- paging
     def model_bytes(self) -> int:
@@ -676,6 +764,11 @@ class InferenceEngine:
             "deploy_swap_seconds",
             "wall time of a weight promote (pointer flip + placement)"
         ).observe(time.perf_counter() - t0, model=self._name)
+        # the version flip changes which live sessions count as pinned;
+        # session gauges otherwise refresh only on set changes
+        sessions = self._sessions
+        if sessions is not None:
+            sessions.refresh_gauges()
         _monitor.gauge(
             "deploy_version",
             "active served weight version").set(version, model=self._name)

@@ -152,3 +152,20 @@ def quantized_output_jit(model, specs, name: str):
                      features, features_mask)
 
     return _monitor.watched_jit(run, name=name)
+
+
+def quantized_decode_jit(model, specs, name: str):
+    """A ``watched_jit`` decode step over the quantized params tree —
+    the ``_decode_step_fn`` analogue of :func:`quantized_output_jit`.
+    Same calling convention as the container's decode step
+    (``(qparams, net_state, carries, features)``), so the int8 engine
+    hands it to ``SessionCache`` via the ``step_fn`` override.  KV-ring
+    state itself stays in the activation dtype: only weights quantize.
+    """
+    inner = model._decode_step_fn.__wrapped__
+
+    def run(qparams, net_state, carries, features):
+        return inner(dequantize_tree(qparams, specs), net_state,
+                     carries, features)
+
+    return _monitor.watched_jit(run, name=name)

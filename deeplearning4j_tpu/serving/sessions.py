@@ -1,4 +1,4 @@
-"""Device-resident per-session RNN state for streaming inference.
+"""Device-resident per-session state trees for streaming inference.
 
 PR 2's engine serves recurrent traffic by full-sequence recompute:
 every request re-runs the whole conversation/series from t=0, so
@@ -9,20 +9,33 @@ carries hidden state between calls — but as a single mutable slot per
 model instance it cannot serve concurrent sessions.
 
 ``SessionCache`` lifts that primitive to N concurrent sessions: each
-session id owns a carry pytree that **stays on device** between
-requests (the arrays returned by the jitted step are never fetched), so
-a streaming request pays exactly ONE single-timestep dispatch — no
-host round-trip for state, no recompute of the prefix.  The step runs
-through the containers' ``rnn_stateless_step`` (explicit carries
-in/out, jitted once per shape through the compile-watch), so the
-one-dispatch-per-request claim is *asserted* by counting
-``jit_compiles_total + jit_cache_hits_total`` for the step fn in
-``tests/test_serving_sessions.py``.
+session id owns a **state tree** that stays on device between requests
+(the arrays returned by the jitted step are never fetched), so a
+streaming request pays exactly ONE single-timestep dispatch — no host
+round-trip for state, no recompute of the prefix.  The state tree is
+whatever the model's carry contract says it is:
+
+- **RNN carries** (h, c per layer) step through the containers'
+  ``rnn_stateless_step`` under the ``serving.rnn_step`` sanitizer
+  scenario (one dispatch per session step);
+- **KV-cache rings** (``nn.layers.attention.CausalSelfAttention``:
+  (batch, heads, cache_len, head_dim) K/V buffers + int32 cursor) step
+  through ``decode_step`` under ``serving.decode_step`` (one dispatch
+  per TOKEN — ``units=T`` for a T-token chunk), with a host-tracked
+  position driving a powers-of-two **cache-len bucket ladder**: a
+  session that outgrows its ring hops to the next bucket via ONE jitted
+  ``grow_decode_carries`` dispatch (budgeted as the scenario's
+  ``extra``), and after engine ``warmup_decode`` every hop is
+  compile-free.  The host never reads the device cursor — position
+  accounting is pure host arithmetic, so no sync point enters the hot
+  path.
 
 Eviction (both counted in ``serving_session_evictions_total``):
 
 - **TTL**: sessions idle longer than ``ttl_s`` are dropped on the next
-  cache operation (abandoned conversations must not pin HBM forever);
+  cache operation (abandoned conversations must not pin HBM forever) —
+  dropping a decode session frees its KV ring's device bytes, visible
+  in the ``serving_session_state_bytes`` gauge;
 - **capacity**: at ``max_sessions`` the least-recently-used session is
   dropped first — the ``NativeModelRunner._execs`` LRU pattern applied
   to session state.
@@ -32,15 +45,20 @@ its steps on a per-session lock (state is a chain — two concurrent
 steps for one session would fork it) while distinct sessions dispatch
 concurrently.
 
-Version pinning (docs/DEPLOY.md): a session's carry pytree is a
-function of the weights that produced it, so advancing old state with
-new weights after a hot-swap would chain two different models'
-dynamics.  Each session records the engine's active weight version at
-creation (``version_fn``) and every subsequent step resolves that
-SAME version's host tree (``weights_fn``) until the session ends or
-its TTL expires — the engine retains a retired version's tree while
-any session pins it.  ``serving_session_version_pinned`` gauges how
-many live sessions are pinned behind the active version.
+Version pinning (docs/DEPLOY.md): a session's state tree is a function
+of the weights that produced it, so advancing old state with new
+weights after a hot-swap would chain two different models' dynamics.
+Each session records the engine's active weight version at creation
+(``version_fn``) and every subsequent step resolves that SAME version's
+host tree (``weights_fn``) until the session ends or its TTL expires —
+the engine retains a retired version's tree while any session pins it.
+``serving_session_version_pinned`` gauges how many live sessions are
+pinned behind the active version.
+
+Error contract: a batch-size or state-structure mismatch raises
+:class:`SessionStateError` naming the offending leaf path — and ONLY
+raises; the stored state is untouched, so :meth:`clear` (or a matching
+request) fully recovers the session slot.
 """
 
 from __future__ import annotations
@@ -54,40 +72,79 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..monitor.locks import make_lock
+from .bucketing import batch_ladder
 
 
 class SessionError(RuntimeError):
     """Session-path failures (unknown/expired ids are NOT errors — a new
-    carry is initialized; batch-size mismatches and unsupported models
-    are)."""
+    state tree is initialized; batch/structure mismatches, unsupported
+    models, and overlong decode sessions are)."""
+
+
+class SessionStateError(SessionError):
+    """A request is incompatible with a session's stored state tree
+    (batch-size change mid-session, or a state structure the current
+    model no longer produces).  ``leaf_path`` names the first offending
+    leaf (``jax.tree_util.keystr`` form, e.g. ``[0][0]`` for an MLN
+    layer-0 carry or ``['attn'][0]`` for a graph vertex ring).  The
+    stored state is left untouched: ``clear()`` the session — or send a
+    matching request — to recover."""
+
+    def __init__(self, message: str, leaf_path: Optional[str] = None):
+        super().__init__(message)
+        self.leaf_path = leaf_path
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        size = int(np.prod(getattr(leaf, "shape", ())) or 1)
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        total += size * itemsize
+    return total
 
 
 class _Session:
     __slots__ = ("carries", "batch", "last_used", "lock", "steps",
-                 "version")
+                 "version", "position", "capacity", "state_bytes")
 
-    def __init__(self, carries, batch: int,
-                 version: Optional[int] = None):
+    def __init__(self, carries, batch: int, version: Optional[int] = None,
+                 capacity: int = 0):
         self.carries = carries
         self.batch = batch
         self.last_used = time.monotonic()
         self.lock = make_lock("serving.session")
         self.steps = 0
         self.version = version
+        self.position = 0          # tokens already decoded (host-side)
+        self.capacity = capacity   # current KV ring bucket (0 = RNN)
+        self.state_bytes = _tree_nbytes(carries)
 
 
 class SessionCache:
-    """Per-session device-resident RNN carries for one model.
+    """Per-session device-resident state trees for one model.
 
     >>> cache = SessionCache(model, ttl_s=300.0, max_sessions=1024)
     >>> y0 = cache.step("sess-1", x_t0)     # one timestep, one dispatch
-    >>> y1 = cache.step("sess-1", x_t1)     # carries stayed on device
+    >>> y1 = cache.step("sess-1", x_t1)     # state stayed on device
     >>> cache.clear("sess-1")               # end of conversation
+
+    For models with KV-cache rings (``model.has_kv_ring()``) the step
+    runs ``decode_step`` under the ``serving.decode_step`` scenario and
+    ring capacity follows a powers-of-two bucket ladder up to the
+    layers' ``cache_len``; a session decoding past the top of the
+    ladder raises :class:`SessionError`.
+
+    ``step_fn`` overrides the model-step callable — the int8 engine
+    passes its quantized-decode jit; the signature must match the
+    container step (``(carries, x, **kw)`` for MLN, ``(carries, *xs,
+    **kw)`` for graphs) and return ``(out, new_carries)``.
     """
 
     def __init__(self, model, *, ttl_s: float = 300.0,
                  max_sessions: int = 1024, name: str = "default",
-                 version_fn=None, weights_fn=None):
+                 version_fn=None, weights_fn=None, step_fn=None):
         from ..nn.computation_graph import ComputationGraph
         model.init()
         model._require_carry_support("SessionCache")
@@ -105,12 +162,32 @@ class SessionCache:
         # resolves the pinned version's host tree (None = live weights)
         self._version_fn = version_fn
         self._weights_fn = weights_fn
+        self._step_fn = step_fn
+        # decode tier: KV-ring models step through decode_step under the
+        # per-token budget and ladder their ring capacity
+        self._decode = bool(getattr(model, "has_kv_ring",
+                                    lambda: False)())
+        self._scenario = ("serving.decode_step" if self._decode
+                          else "serving.rnn_step")
+        self._cache_ladder = (batch_ladder(model.max_cache_len())
+                              if self._decode else ())
 
     # ------------------------------------------------------------- metrics
+    # Refreshed when the session SET changes (create/evict/clear), not
+    # per step: three labelled gauge writes plus a per-session sum cost
+    # more than a decode dispatch, and nothing they publish moves while
+    # an existing session steps (a ring grow defers its state_bytes
+    # delta to the next set change; ``state_bytes()`` is always live).
     def _observe_active(self) -> None:
         _monitor.gauge("serving_sessions_active",
-                       "live device-resident RNN sessions").set(
+                       "live device-resident serving sessions").set(
             len(self._sessions), model=self._name)
+        _monitor.gauge(
+            "serving_session_state_bytes",
+            "device bytes held by live session state trees "
+            "(RNN carries + KV-cache rings)").set(
+            sum(s.state_bytes for s in self._sessions.values()),
+            model=self._name)
         if self._version_fn is not None:
             active = self._version_fn()
             pinned = sum(1 for s in self._sessions.values()
@@ -120,10 +197,65 @@ class SessionCache:
                 "live sessions pinned to a non-active weight version"
             ).set(pinned, model=self._name)
 
+    def refresh_gauges(self) -> None:
+        """Re-publish the session gauges outside a set change: the
+        pinned count moves when the ENGINE's active version flips
+        (promote/swap_weights), not when the session set does."""
+        with self._lock:
+            self._observe_active()
+
     def _count_eviction(self, reason: str) -> None:
         _monitor.counter("serving_session_evictions_total",
                          "sessions evicted from the device cache").inc(
             model=self._name, reason=reason)
+
+    # ------------------------------------------------------- state checks
+    def _check_state(self, session_id: str, sess: _Session,
+                     batch: int) -> None:
+        """Raise :class:`SessionStateError` naming the first offending
+        leaf when the stored state tree cannot serve this request.
+        Leaf-path naming works for ANY state tree (RNN carries, KV
+        rings, future state classes) — no RNN assumptions."""
+        import jax
+        if sess.batch == batch:
+            return
+        path = None
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+                sess.carries)[0]:
+            shape = getattr(leaf, "shape", ())
+            if len(shape) >= 1 and shape[0] == sess.batch:
+                path = jax.tree_util.keystr(kp)
+                break
+        raise SessionStateError(
+            f"session {session_id!r} holds state for batch size "
+            f"{sess.batch} (first batch-carrying leaf: "
+            f"{path or '<none>'}), got {batch}; clear() the session "
+            "between unrelated sequences", leaf_path=path)
+
+    def _check_structure(self, session_id: str, sess: _Session) -> None:
+        """A session whose stored tree no longer matches the model's
+        state structure (e.g. state injected from an older architecture)
+        must fail with the offending path, not a jit tracer error."""
+        import jax
+        got = jax.tree.structure(sess.carries)
+        want = jax.tree.structure(
+            self._model._init_carries(sess.batch) if not sess.capacity
+            else self._model._init_carries(sess.batch,
+                                           cache_len=sess.capacity))
+        if got == want:
+            return
+        got_paths = [jax.tree_util.keystr(kp) for kp, _ in
+                     jax.tree_util.tree_flatten_with_path(sess.carries)[0]]
+        want_paths = [jax.tree_util.keystr(kp) for kp, _ in
+                      jax.tree_util.tree_flatten_with_path(
+                          self._model._init_carries(sess.batch))[0]]
+        odd = next((p for p in got_paths if p not in want_paths),
+                   next((p for p in want_paths if p not in got_paths),
+                        "<structure>"))
+        raise SessionStateError(
+            f"session {session_id!r} state tree does not match the "
+            f"model's carry structure (offending leaf: {odd}); clear() "
+            "the session", leaf_path=odd)
 
     # ------------------------------------------------------------ stepping
     def step(self, session_id: str, features,
@@ -135,8 +267,9 @@ class SessionCache:
         ``(batch, n_out)``; 3-D ``(batch, time, features)`` advances by
         a chunk and returns ``(batch, time, n_out)``.  Unknown session
         ids start from zero state.  A batch-size change mid-session
-        raises (reference ``rnnTimeStep`` semantics) — call
-        :meth:`clear` between unrelated sequences.
+        raises :class:`SessionStateError` naming the offending leaf
+        (reference ``rnnTimeStep`` semantics) — call :meth:`clear`
+        between unrelated sequences.
         """
         if self._is_graph:
             feats = (tuple(features) if isinstance(features, (list, tuple))
@@ -147,19 +280,17 @@ class SessionCache:
             if squeeze:   # (batch, feat) = one timestep
                 arrays = tuple(a[:, None, :] if a.ndim == 2 else a
                                for a in arrays)
+            steps = int(arrays[0].shape[1])
         else:
             x = np.asarray(features, dtype=dtype)
             batch = int(x.shape[0])
             squeeze = x.ndim == 2
             if squeeze:   # (batch, feat) = one timestep
                 x = x[:, None, :]
-        sess = self._acquire(session_id, batch)
+            steps = int(x.shape[1])
+        sess = self._acquire(session_id, batch, steps)
         with sess.lock:
-            if sess.batch != batch:
-                raise SessionError(
-                    f"session {session_id!r} holds state for batch size "
-                    f"{sess.batch}, got {batch}; clear() the session "
-                    "between unrelated sequences")
+            self._check_state(session_id, sess, batch)
             # Version pinning: a session created before a weight swap
             # keeps stepping with the version its carries came from.
             kw = {}
@@ -167,16 +298,31 @@ class SessionCache:
                 w = self._weights_fn(sess.version)
                 if w is not None:
                     kw = {"params": w[0], "net_state": w[1]}
-            # ONE dispatch: explicit-carry step, carries stay on device
-            # (the budgeted contract the armed sanitizer asserts)
-            with _monitor.sanitize_scenario("serving.rnn_step"):
-                if self._is_graph:
-                    outs, sess.carries = self._model.rnn_stateless_step(
-                        sess.carries, *arrays, **kw)
-                    out = outs[0] if len(outs) == 1 else outs
-                else:
-                    out, sess.carries = self._model.rnn_stateless_step(
-                        sess.carries, x, **kw)
+            grow_to = 0
+            if self._decode:
+                grow_to = self._bucket_for(session_id, sess, steps)
+            # ONE dispatch per token (+1 for a bucket hop): explicit-
+            # state step, state stays on device — the budgeted contract
+            # the armed sanitizer asserts (tools/analyze/budgets.json)
+            with _monitor.sanitize_scenario(
+                    self._scenario,
+                    units=(steps if self._decode else 1),
+                    extra=(1 if grow_to else 0)):
+                if grow_to:
+                    try:
+                        sess.carries = self._model.grow_decode_carries(
+                            sess.carries, grow_to)
+                    except Exception:
+                        # same typed-error contract as the step itself:
+                        # a stored tree the model cannot grow gets
+                        # diagnosed, never a raw tracer error
+                        self._check_structure(session_id, sess)
+                        raise
+                    sess.capacity = grow_to
+                    sess.state_bytes = _tree_nbytes(sess.carries)
+                out, sess.carries = self._dispatch(
+                    session_id, sess, arrays if self._is_graph else x, kw)
+            sess.position += steps
             sess.steps += 1
             sess.last_used = time.monotonic()
         _monitor.counter("serving_session_steps_total",
@@ -189,37 +335,102 @@ class SessionCache:
         out = np.asarray(out)
         return out[:, -1] if squeeze and out.ndim == 3 else out
 
-    def _acquire(self, session_id: str, batch: int) -> _Session:
+    def _dispatch(self, session_id: str, sess: _Session, features, kw):
+        """One compiled step of the session's state tree."""
+        try:
+            if self._step_fn is not None:
+                if self._is_graph:
+                    outs, new = self._step_fn(sess.carries, *features,
+                                              **kw)
+                else:
+                    return self._step_fn(sess.carries, features, **kw)
+            elif self._decode:
+                if self._is_graph:
+                    outs, new = self._model.decode_step(
+                        sess.carries, *features, **kw)
+                else:
+                    return self._model.decode_step(sess.carries, features,
+                                                   **kw)
+            else:
+                if self._is_graph:
+                    outs, new = self._model.rnn_stateless_step(
+                        sess.carries, *features, **kw)
+                else:
+                    return self._model.rnn_stateless_step(
+                        sess.carries, features, **kw)
+        except SessionError:
+            raise
+        except Exception:
+            # a state tree the step cannot consume surfaces as whatever
+            # the tracer threw; diagnose against the model's expected
+            # carry structure first (a mismatch raises the typed error
+            # naming the leaf), and re-raise the original otherwise
+            self._check_structure(session_id, sess)
+            raise
+        return (outs[0] if len(outs) == 1 else outs), new
+
+    def _bucket_for(self, session_id: str, sess: _Session,
+                    steps: int) -> int:
+        """The ladder bucket this chunk needs, or 0 when the current
+        ring already fits.  Raises past the top of the ladder."""
+        need = sess.position + steps
+        if need <= sess.capacity:
+            return 0
+        for cap in self._cache_ladder:
+            if cap >= need and cap > sess.capacity:
+                return cap
+        raise SessionError(
+            f"session {session_id!r} has decoded {sess.position} tokens; "
+            f"{steps} more would exceed the model's cache_len "
+            f"{self._cache_ladder[-1] if self._cache_ladder else 0} — "
+            "clear() the session or raise the layer's cache_len")
+
+    def _acquire(self, session_id: str, batch: int,
+                 steps: int = 1) -> _Session:
         now = time.monotonic()
         with self._lock:
-            self._sweep_locked(now)
+            changed = self._sweep_locked(now)
             sess = self._sessions.get(session_id)
             if sess is None:
+                changed = True
                 while len(self._sessions) >= self._max_sessions:
                     self._sessions.popitem(last=False)   # LRU out
                     self._count_eviction("capacity")
-                carries = self._model._init_carries(batch)
+                capacity = 0
+                if self._decode:
+                    capacity = self._cache_ladder[0]
+                    for cap in self._cache_ladder:
+                        if cap >= steps:
+                            capacity = cap
+                            break
+                    carries = self._model._init_carries(
+                        batch, cache_len=capacity)
+                else:
+                    carries = self._model._init_carries(batch)
                 version = (self._version_fn()
                            if self._version_fn is not None else None)
                 sess = self._sessions[session_id] = _Session(
-                    carries, batch, version)
+                    carries, batch, version, capacity)
             else:
                 self._sessions.move_to_end(session_id)   # LRU touch
-            self._observe_active()
+            if changed:
+                self._observe_active()
             return sess
 
-    def _sweep_locked(self, now: float) -> None:
+    def _sweep_locked(self, now: float) -> bool:
         if self._ttl_s <= 0:
-            return
+            return False
         dead = [sid for sid, s in self._sessions.items()
                 if now - s.last_used > self._ttl_s]
         for sid in dead:
             del self._sessions[sid]
             self._count_eviction("ttl")
+        return bool(dead)
 
     # ---------------------------------------------------------- management
     def clear(self, session_id: str) -> bool:
-        """Drop one session's device state (end of conversation)."""
+        """Drop one session's device state (end of conversation) — the
+        documented recovery from :class:`SessionStateError`."""
         with self._lock:
             gone = self._sessions.pop(session_id, None) is not None
             self._observe_active()
@@ -245,11 +456,30 @@ class SessionCache:
             return None if sess is None else sess.version
 
     def get_carries(self, session_id: str):
-        """The session's carry pytree (device arrays), or None —
+        """The session's state tree (device arrays), or None —
         ``rnn_get_previous_state`` lifted to named sessions."""
         with self._lock:
             sess = self._sessions.get(session_id)
             return None if sess is None else sess.carries
+
+    def session_position(self, session_id: str) -> int:
+        """Tokens decoded so far (host-tracked; 0 for unknown ids)."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return 0 if sess is None else sess.position
+
+    def session_capacity(self, session_id: str) -> int:
+        """Current KV ring bucket (0 for RNN sessions/unknown ids)."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return 0 if sess is None else sess.capacity
+
+    def state_bytes(self) -> int:
+        """Device bytes held by every live session's state tree — what
+        TTL eviction frees (the registry's accounting sees the same
+        number via the ``serving_session_state_bytes`` gauge)."""
+        with self._lock:
+            return sum(s.state_bytes for s in self._sessions.values())
 
     def __len__(self) -> int:
         with self._lock:
@@ -262,6 +492,9 @@ class SessionCache:
                 "sessions": len(self._sessions),
                 "max_sessions": self._max_sessions,
                 "ttl_s": self._ttl_s,
+                "decode": self._decode,
+                "state_bytes": sum(s.state_bytes
+                                   for s in self._sessions.values()),
                 "oldest_idle_s": round(
                     max((now - s.last_used for s in
                          self._sessions.values()), default=0.0), 3),

@@ -1,0 +1,383 @@
+"""Autoregressive decode tests: the KV-cache ring serving tier.
+
+The contracts under test (docs/SERVING.md "Autoregressive decode"):
+
+- **parity** — N single-token ``decode_step`` calls reproduce one
+  full-sequence ``output()`` (f64 at the repo's last-ulp idiom
+  ``rtol=0, atol=1e-15``; chunked decode is EXACTLY bitwise equal at
+  any ring capacity — masked slots contribute exact zeros), across
+  fp32 and mixed_bf16 policies;
+- **one dispatch per token** — a session step executes exactly one
+  ``decode_step`` dispatch per token (counted through the
+  compile-watch), with a cache-len bucket hop adding exactly one
+  ``decode_grow`` dispatch;
+- **compile-free bucket hops** — after ``warmup_decode``, stepping a
+  session across cache-len bucket boundaries causes ZERO fresh
+  compiles, asserted both by compile counters and by the armed
+  sanitizer (``serving.decode_step`` budget, zero violations);
+- **int8 agreement** — the quantized decode session output agrees with
+  the f64 reference within the registry's int8 gate;
+- **state accounting** — TTL eviction frees the KV ring's device bytes
+  (``serving_session_state_bytes``), and a batch/structure mismatch
+  raises ``SessionStateError`` naming the offending leaf path with
+  ``clear()`` as the documented recovery.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf import inputs
+from deeplearning4j_tpu.nn.layers.attention import CausalSelfAttention
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.serving import InferenceEngine, SessionCache
+from deeplearning4j_tpu.serving.bucketing import batch_ladder
+from deeplearning4j_tpu.serving.sessions import (SessionError,
+                                                 SessionStateError)
+from tools.analyze import sanitizer
+
+
+def _decode_model(seed=5, cache_len=32, dtype="float64", n_in=8,
+                  hidden=16, heads=4, n_out=4, T=16):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .dtype(dtype).list()
+            .layer(CausalSelfAttention(n_out=hidden, n_heads=heads,
+                                       cache_len=cache_len))
+            .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(inputs.recurrent(n_in, T))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _decode_graph(seed=11, cache_len=32):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .dtype("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", CausalSelfAttention(
+                n_in=8, n_out=16, n_heads=4, cache_len=cache_len), "in")
+            .add_layer("out", RnnOutputLayer(n_in=16, n_out=4,
+                                             activation="softmax",
+                                             loss="mcxent"), "attn")
+            .set_outputs("out")
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _dispatches(fn):
+    """Dispatch count of one jitted program = compiles + cache hits
+    (the test_ingest.py idiom)."""
+    c = monitor.counter("jit_compiles_total", "")
+    h = monitor.counter("jit_cache_hits_total", "")
+    return c.value(fn=fn) + h.value(fn=fn)
+
+
+def _compiles(*fns):
+    c = monitor.counter("jit_compiles_total", "")
+    return sum(c.value(fn=f) for f in fns)
+
+
+# ---- parity: N single-token steps == one full sequence -------------------
+
+def test_decode_chunk_is_bitwise_capacity_independent():
+    """The bit-parity foundation: masked ring slots contribute EXACT
+    zeros, so a decode chunk is bitwise identical to output() at any
+    ring capacity."""
+    model = _decode_model()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(2, 16, 8)
+    full = np.asarray(model.output(xs))
+    for cap in (16, 32, 64):
+        carries = model._init_carries(2, cache_len=cap)
+        out, _ = model.decode_step(carries, xs)
+        np.testing.assert_array_equal(np.asarray(out), full)
+
+
+def test_decode_steps_bitmatch_full_sequence_f64():
+    """16 single-token session steps reproduce output() to the last ulp
+    in f64 — the decode analogue of the RNN session parity test."""
+    model = _decode_model()
+    cache = SessionCache(model, name="dec-parity")
+    rng = np.random.RandomState(1)
+    xs = rng.randn(2, 16, 8)
+    full = np.asarray(model.output(xs))
+    stepped = np.stack([cache.step("s", xs[:, t]) for t in range(16)],
+                       axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=0, atol=1e-15)
+    assert cache.session_position("s") == 16
+
+
+def test_decode_chunked_session_matches_full_sequence():
+    """Mixed chunk sizes (prefill 10 + 6 single tokens) ride the same
+    ring; hops across cache-len buckets never change results."""
+    model = _decode_model()
+    cache = SessionCache(model, name="dec-chunks")
+    rng = np.random.RandomState(2)
+    xs = rng.randn(3, 16, 8)
+    full = np.asarray(model.output(xs))
+    outs = [cache.step("s", xs[:, :10])]
+    outs += [cache.step("s", xs[:, t])[:, None] for t in range(10, 16)]
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                               rtol=0, atol=1e-15)
+
+
+def test_decode_parity_fp32_policy():
+    model = _decode_model(dtype="float32")
+    cache = SessionCache(model, name="dec-f32")
+    rng = np.random.RandomState(3)
+    xs = rng.randn(2, 12, 8).astype(np.float32)
+    full = np.asarray(model.output(xs))
+    stepped = np.stack([cache.step("s", xs[:, t]) for t in range(12)],
+                       axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=0, atol=1e-6)
+
+
+def test_decode_parity_mixed_bf16_policy(monkeypatch):
+    """Under mixed_bf16 the fp32-logits head contract must hold on the
+    decode path too (the head runs its fp32 half even with carries
+    threaded): outputs are fp32 and track the full-sequence forward."""
+    monkeypatch.setenv("DL4J_TPU_PRECISION", "mixed_bf16")
+    model = _decode_model(dtype="float32")
+    cache = SessionCache(model, name="dec-bf16")
+    rng = np.random.RandomState(4)
+    xs = rng.randn(2, 12, 8).astype(np.float32)
+    full = np.asarray(model.output(xs))
+    assert full.dtype == np.float32           # fp32-logits contract
+    stepped = np.stack([cache.step("s", xs[:, t]) for t in range(12)],
+                       axis=1)
+    assert stepped.dtype == np.float32
+    np.testing.assert_allclose(stepped, full, rtol=0, atol=2e-2)
+
+
+def test_graph_decode_parity():
+    g = _decode_graph()
+    cache = SessionCache(g, name="dec-graph")
+    rng = np.random.RandomState(5)
+    xs = rng.randn(2, 12, 8)
+    full = np.asarray(g.output(xs))
+    stepped = np.stack([cache.step("s", xs[:, t]) for t in range(12)],
+                       axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=0, atol=1e-15)
+
+
+# ---- dispatch economics --------------------------------------------------
+
+def test_decode_step_is_one_dispatch_per_token():
+    model = _decode_model(cache_len=16)
+    cache = SessionCache(model, name="dec-dispatch")
+    rng = np.random.RandomState(6)
+    cache.step("s", rng.randn(2, 8))           # warm (compiles)
+    for t in range(1, 8):
+        before = _dispatches("mln.decode_step")
+        grow_before = _dispatches("mln.decode_grow")
+        cache.step("s", rng.randn(2, 8))
+        assert _dispatches("mln.decode_step") - before == 1
+        hops = _dispatches("mln.decode_grow") - grow_before
+        assert hops <= 1                       # a hop adds ONE grow
+
+
+def test_bucket_hop_zero_recompiles_after_warmup():
+    """warmup_decode pre-compiles the (batch, cache_len) grid + grow
+    transitions; stepping a session across every bucket boundary after
+    that causes ZERO fresh compiles."""
+    model = _decode_model(cache_len=32)
+    rng = np.random.RandomState(7)
+    with InferenceEngine(model, max_batch_size=4,
+                         name="dec-warm") as eng:
+        eng.warmup_decode((8,), chunk_lens=(1,))
+        fns = ("mln.decode_step", "mln.decode_grow")
+        before = _compiles(*fns)
+        for _ in range(32):                    # crosses 1->2->...->32
+            eng.predict_session("s", rng.randn(2, 8))
+        assert _compiles(*fns) - before == 0
+        assert eng.sessions.session_capacity("s") == 32
+
+
+def test_decode_sanitizer_budget_holds(monkeypatch):
+    """Armed sanitizer proves the serving.decode_step contract: one
+    dispatch per token (+1 for a hop), zero violations across bucket
+    hops after warmup."""
+    monkeypatch.setenv("DL4J_TPU_SANITIZE", "1")
+    monkeypatch.delenv("DL4J_TPU_SANITIZE_STRICT", raising=False)
+    monkeypatch.delenv("DL4J_TPU_SANITIZE_BUDGETS", raising=False)
+    sanitizer.reset()
+    try:
+        model = _decode_model(cache_len=16)
+        rng = np.random.RandomState(8)
+        with InferenceEngine(model, max_batch_size=4,
+                             name="dec-san") as eng:
+            eng.warmup_decode((8,), chunk_lens=(1, 4))
+            monitor.sanitize_end_warmup()
+            for _ in range(12):                # hops 1->2->4->8->16
+                eng.predict_session("s", rng.randn(1, 8))
+            eng.predict_session("c", rng.randn(1, 4, 8))   # 4-token chunk
+        assert sanitizer.violation_count() == 0, sanitizer.violations()
+    finally:
+        sanitizer.reset()
+
+
+def test_decode_session_past_cache_len_raises():
+    model = _decode_model(cache_len=8)
+    cache = SessionCache(model, name="dec-over")
+    rng = np.random.RandomState(9)
+    for _ in range(8):
+        cache.step("s", rng.randn(1, 8))
+    with pytest.raises(SessionError, match="cache_len"):
+        cache.step("s", rng.randn(1, 8))
+    assert cache.clear("s")
+    cache.step("s", rng.randn(1, 8))           # slot fully recovered
+
+
+# ---- int8 ----------------------------------------------------------------
+
+def test_int8_decode_agreement_gate():
+    """int8 decode sessions (quantized_decode_jit via the step_fn
+    override) agree with the f64 reference within the registry's int8
+    tolerance, and chunked vs single-token int8 decode match each
+    other at the last ulp."""
+    model = _decode_model(seed=9)
+    rng = np.random.RandomState(10)
+    xs = rng.randn(2, 12, 8)
+    ref = np.asarray(model.output(xs))
+    with InferenceEngine(model, max_batch_size=4, quantize="int8",
+                         name="dec-int8") as eng:
+        eng.warmup_decode((8,))
+        stepped = np.stack([eng.predict_session("q", xs[:, t])
+                            for t in range(12)], axis=1)
+        assert float(np.abs(stepped - ref).max()) < 0.05
+        eng.sessions.clear("q")
+        chunked = np.concatenate(
+            [np.asarray(eng.predict_session("q", xs[:, :6])),
+             np.asarray(eng.predict_session("q", xs[:, 6:]))], axis=1)
+        np.testing.assert_allclose(chunked, stepped, rtol=0, atol=1e-15)
+
+
+# ---- state accounting + typed errors -------------------------------------
+
+def test_ttl_eviction_frees_kv_ring_bytes():
+    model = _decode_model()
+    cache = SessionCache(model, name="dec-ttl", ttl_s=0.05)
+    rng = np.random.RandomState(11)
+    cache.step("s", rng.randn(2, 8))
+    held = cache.state_bytes()
+    assert held > 0                            # the ring is real bytes
+    time.sleep(0.1)
+    cache.step("other", rng.randn(1, 8))       # sweep runs on acquire
+    assert cache.get_carries("s") is None
+    assert cache.state_bytes() < held
+    vals = monitor.snapshot().get("serving_session_evictions_total",
+                                  {}).get("values", {})
+    assert any('reason="ttl"' in k and 'model="dec-ttl"' in k
+               for k in vals)
+    gauge = monitor.snapshot().get("serving_session_state_bytes",
+                                   {}).get("values", {})
+    assert any('model="dec-ttl"' in k for k in gauge)
+
+
+def test_batch_change_raises_typed_error_naming_leaf():
+    model = _decode_model()
+    cache = SessionCache(model, name="dec-guard")
+    rng = np.random.RandomState(12)
+    cache.step("s", rng.randn(2, 8))
+    with pytest.raises(SessionStateError) as ei:
+        cache.step("s", rng.randn(3, 8))
+    assert ei.value.leaf_path == "[0][0]"      # layer-0 k_cache leaf
+    assert "[0][0]" in str(ei.value)
+    assert cache.clear("s")
+    cache.step("s", rng.randn(3, 8))           # clear() fully recovers
+
+
+def test_structure_mismatch_raises_typed_error():
+    """A stored tree the model's step cannot consume (e.g. state from
+    an older architecture) surfaces as SessionStateError naming the
+    offending path — not a raw tracer error."""
+    model = _decode_model()
+    cache = SessionCache(model, name="dec-struct")
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 8)
+    cache.step("s", x)
+    with cache._lock:
+        sess = cache._sessions["s"]
+        sess.carries = sess.carries[:1]        # drop the head's carry
+    with pytest.raises(SessionStateError):
+        cache.step("s", x)
+    assert cache.clear("s")
+    cache.step("s", x)                         # recovered from zero state
+
+
+def test_rnn_sessions_unaffected_by_decode_generalization():
+    """Non-ring models keep the serving.rnn_step path: no position
+    ladder, capacity 0, same parity as before."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+    conf = (NeuralNetConfiguration.builder().seed(7).dtype("float64")
+            .list()
+            .layer(GravesLSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(inputs.recurrent(3, 6))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    assert not model.has_kv_ring()
+    cache = SessionCache(model, name="rnn-regress")
+    assert not cache._decode
+    rng = np.random.RandomState(14)
+    xs = rng.randn(2, 6, 3)
+    full = np.asarray(model.output(xs))
+    stepped = np.stack([cache.step("s", xs[:, t]) for t in range(6)],
+                       axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=0, atol=1e-15)
+    assert cache.session_capacity("s") == 0
+
+
+# ---- layer-level contracts -----------------------------------------------
+
+def test_forward_seq_overflow_and_shrink_raise():
+    layer = CausalSelfAttention(n_in=8, n_out=16, n_heads=4, cache_len=4)
+    with pytest.raises(ValueError, match="cache_len"):
+        layer.init_carry(1, np.float64, cache_len=0)
+    carry = layer.init_carry(1, np.float64, cache_len=8)
+    with pytest.raises(ValueError, match="shrink"):
+        layer.grow_carry(carry, 4)
+    model = _decode_model(cache_len=4)
+    carries = model._init_carries(1, cache_len=4)
+    with pytest.raises(ValueError, match="capacity"):
+        model.decode_step(carries, np.zeros((1, 8, 8)))
+
+
+def test_heads_must_divide_width():
+    with pytest.raises(ValueError, match="divide"):
+        _decode_model(hidden=16, heads=3)
+
+
+def test_training_rides_flash_causal_and_serde_roundtrips():
+    """fit() trains the attention stack through the fused causal
+    flash kernel (score decreases), and the layer round-trips the
+    conf JSON serde."""
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    model = _decode_model(dtype="float32", T=8)
+    rng = np.random.RandomState(15)
+    xs = rng.randn(8, 8, 8).astype(np.float32)
+    labels = np.zeros((8, 8, 4), np.float32)
+    labels[..., 0] = 1.0
+    it = ListDataSetIterator(DataSet(xs, labels), batch_size=4)
+    model.fit(it, epochs=1)
+    s0 = model.score()
+    model.fit(it, epochs=3)
+    assert model.score() < s0
+    conf2 = type(model.conf).from_json(model.conf.to_json())
+    layer = conf2.layers[0]
+    assert isinstance(layer, CausalSelfAttention)
+    assert (layer.n_heads, layer.cache_len) == (4, 32)
+
+
+def test_cache_ladder_is_batch_ladder_over_cache_len():
+    model = _decode_model(cache_len=48)
+    cache = SessionCache(model, name="dec-ladder")
+    assert model.max_cache_len() == 48
+    assert cache._cache_ladder == batch_ladder(48)
+    assert cache._cache_ladder[-1] == 48

@@ -24,6 +24,10 @@ Checks (each failed check is one finding):
   open-loop rate, either as the headline metric or as an extra field)
   form their own series, gated the same way as the headline: newest
   vs the trailing median, ``--tolerance`` fractional drop.
+- **decode throughput drop** — rounds carrying
+  ``decode_tokens_per_sec`` (the ``bench.py --decode`` KV-ring
+  one-dispatch-per-token rate, headline or extra field) form another
+  sparse series with the same trailing-median gate.
 
 Output: findings on stdout (``--json`` for machine-readable) and a
 ``PERF_REPORT.md`` snapshot of the trajectory + verdicts (suppress with
@@ -83,6 +87,10 @@ def load_rounds(root: str) -> list:
         if fleet_rps is None \
                 and parsed.get("metric") == "fleet_requests_per_sec":
             fleet_rps = parsed.get("value")
+        decode_tps = parsed.get("decode_tokens_per_sec")
+        if decode_tps is None \
+                and parsed.get("metric") == "decode_tokens_per_sec":
+            decode_tps = parsed.get("value")
         rounds.append({
             "round": int(doc.get("n", m.group(1))),
             "file": os.path.basename(path),
@@ -93,6 +101,7 @@ def load_rounds(root: str) -> list:
             "batch": parsed.get("batch"),
             "hbm_bytes_per_step": parsed.get("hbm_bytes_per_step"),
             "fleet_requests_per_sec": fleet_rps,
+            "decode_tokens_per_sec": decode_tps,
         })
     rounds.sort(key=lambda r: r["round"])
     return rounds
@@ -163,6 +172,37 @@ def check_fleet_throughput(rounds: list, tolerance: float,
     return []
 
 
+def check_decode_throughput(rounds: list, tolerance: float,
+                            trailing: int) -> list:
+    """Newest decode tokens/sec round vs its trailing median.
+
+    Like the fleet series, decode rounds are sparse (only rounds where
+    the driver ran ``bench.py --decode`` carry the rate), so the
+    KV-ring one-dispatch-per-token throughput gets its own
+    trailing-median gate."""
+    usable = [r for r in rounds
+              if r["decode_tokens_per_sec"] is not None
+              and r["rc"] == 0]
+    if len(usable) < 2:
+        return []
+    head = usable[-1]
+    prior = [r["decode_tokens_per_sec"] for r in usable[:-1]][-trailing:]
+    base = statistics.median(prior)
+    if base <= 0:
+        return []
+    drop = (base - head["decode_tokens_per_sec"]) / base
+    head["decode_drop_vs_trailing"] = round(drop, 4)
+    if drop > tolerance:
+        return [Finding(
+            "decode-throughput",
+            f"{head['file']}: decode_tokens_per_sec = "
+            f"{head['decode_tokens_per_sec']:.1f} is "
+            f"{drop * 100:.1f}% below the trailing median {base:.1f} "
+            f"of the previous {len(prior)} decode round(s) "
+            f"(tolerance {tolerance * 100:.0f}%)")]
+    return []
+
+
 def check_bytes(rounds: list, tolerance: float) -> list:
     """Newest recorded hbm_bytes_per_step vs the history minimum."""
     series = [(r["file"], r["hbm_bytes_per_step"]) for r in rounds
@@ -200,8 +240,8 @@ def write_report(path: str, rounds: list, findings: list,
         "## Trajectory",
         "",
         "| round | metric | value | batch | hbm bytes/step "
-        "| fleet req/s | rc |",
-        "|---|---|---|---|---|---|---|",
+        "| fleet req/s | decode tok/s | rc |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in rounds:
         value = "-" if r["value"] is None else f"{r['value']:.1f}"
@@ -209,9 +249,12 @@ def write_report(path: str, rounds: list, findings: list,
                else f"{r['hbm_bytes_per_step']:.0f}")
         fleet = ("-" if r.get("fleet_requests_per_sec") is None
                  else f"{r['fleet_requests_per_sec']:.1f}")
+        decode = ("-" if r.get("decode_tokens_per_sec") is None
+                  else f"{r['decode_tokens_per_sec']:.1f}")
         lines.append(
             f"| r{r['round']:02d} | {r['metric'] or '-'} | {value} "
-            f"| {r['batch'] or '-'} | {hbm} | {fleet} | {r['rc']} |")
+            f"| {r['batch'] or '-'} | {hbm} | {fleet} | {decode} "
+            f"| {r['rc']} |")
     lines += ["", "## Verdict", ""]
     if findings:
         lines += [f"- **FAIL** {f}" for f in findings]
@@ -239,6 +282,8 @@ def run(root: str, args) -> list:
     findings += check_throughput(rounds, args.tolerance, args.trailing)
     findings += check_fleet_throughput(rounds, args.tolerance,
                                        args.trailing)
+    findings += check_decode_throughput(rounds, args.tolerance,
+                                        args.trailing)
     findings += check_bytes(rounds, args.bytes_tolerance)
     if not args.no_report:
         write_report(args.report or os.path.join(root, "PERF_REPORT.md"),
