@@ -48,15 +48,16 @@ from .attribution import StepAttributor, breakdown as wall_breakdown
 from .jit_watch import WatchedJit, publish_cost_analysis, watched_jit
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, registry)
 from .tracing import (TraceContext, Tracer, attach, current_context,
-                      detach, new_trace_id, parse_traceparent, span,
-                      tracer)
+                      current_trace_hex, detach, new_trace_id,
+                      parse_traceparent, span, tracer)
 
 __all__ = [
     "AlertEngine", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "Rule", "StepAttributor", "TraceContext", "Tracer",
     "TrainingDivergedError", "WatchedJit", "alert_status", "alerts",
     "attach", "attribution", "counter", "current_context",
-    "default_rules", "detach", "disable_health", "enable_health",
+    "current_trace_hex", "default_rules", "detach", "disable_health",
+    "enable_health",
     "flight_recorder", "gauge", "health", "health_enabled",
     "health_snapshot", "histogram", "incident_dir", "new_trace_id",
     "observe_phase", "parse_traceparent", "phase_breakdown",

@@ -297,21 +297,33 @@ class AlertEngine:
 
     def _burn(self, rule: Rule, snap: Dict, now: float,
               window_s: float) -> Tuple[float, float]:
-        """(observed_burn, total_events) over one window."""
+        """(observed_burn, total_events) over one window.  With
+        ``labels`` pinned this is that one series' burn; with
+        ``labels=None`` every series burns independently and the worst
+        series clearing ``min_events`` decides — a per-tenant (or
+        per-version) fan-out must page on its worst member, not on an
+        aggregate a big healthy tenant can dilute."""
         prev_values: Dict[str, Any] = {}
         prev = self._at_or_before(now - window_s)
         if prev is not None:
             prev_values = prev[1].get(rule.metric, {}).get("values", {})
-        total = bad = 0.0
+        worst_burn = 0.0
+        worst_total = 0.0
+        agg_total = 0.0
         for key, val in _series(snap, rule.metric, rule.labels):
             t1, b1 = _bad_good(val, rule.slo_ms)
             t0, b0 = _bad_good(prev_values.get(key), rule.slo_ms)
-            total += max(0.0, t1 - t0)
-            bad += max(0.0, b1 - b0)
-        if total < rule.min_events:
-            return 0.0, total
-        frac = bad / total
-        return frac / (1.0 - rule.objective), total
+            total = max(0.0, t1 - t0)
+            bad = max(0.0, b1 - b0)
+            agg_total += total
+            if total < rule.min_events:
+                continue
+            burn = (bad / total) / (1.0 - rule.objective)
+            if burn >= worst_burn:
+                worst_burn, worst_total = burn, total
+        if worst_total >= rule.min_events:
+            return worst_burn, worst_total
+        return 0.0, agg_total
 
     # ------------------------------------------------------------- evaluation
     def _check(self, rule: Rule, snap: Dict, now: float,
@@ -609,6 +621,23 @@ def default_rules() -> List[Rule]:
              severity="ticket",
              description="the step-time attributor flagged 3+ slow-"
                          "step anomalies within 2 minutes"),
+        # labels=None on a tenant-labelled histogram: the worst tenant
+        # series decides, so one noisy tenant burning its budget pages
+        # even while the aggregate latency looks fine
+        Rule("tenant_slo_burn", "burn_rate",
+             "serving_tenant_latency_ms", slo_ms=50.0, objective=0.99,
+             windows=((60.0, 14.4), (300.0, 6.0)), min_events=20,
+             for_intervals=1, clear_intervals=3, severity="page",
+             gate_deploy=True,
+             description="some tenant's serving latency is burning its "
+                         "99% error budget on both the fast and slow "
+                         "windows"),
+        Rule("tenant_unfairness", "threshold",
+             "serving_tenant_unfairness", op=">", threshold=1.5,
+             for_intervals=2, clear_intervals=2, severity="page",
+             description="cross-tenant unfairness: a victim tenant's "
+                         "p99 inflated over 1.5x its unloaded baseline "
+                         "while an over-share tenant goes unshed"),
     ]
 
 
@@ -639,6 +668,16 @@ def fleet_rules(slo_p99_ms: float = 100.0,
              for_intervals=8, clear_intervals=1, severity="ticket",
              description="fleet p99 far under the SLO for a sustained "
                          "window: drain a worker"),
+        # the router watches per-tenant posture in observe-only mode;
+        # this fires when a victim tenant's p99 inflates while the
+        # over-share tenant crosses the front door unshed
+        Rule("tenant_unfairness", "threshold",
+             "serving_tenant_unfairness", op=">", threshold=1.5,
+             for_intervals=2, clear_intervals=2, severity="page",
+             description="cross-tenant unfairness at the fleet front "
+                         "door: victim p99 inflated over 1.5x its "
+                         "unloaded baseline while an over-share tenant "
+                         "goes unshed"),
     ]
 
 

@@ -401,3 +401,13 @@ def current_context() -> Optional[TraceContext]:
     """The ambient context on this thread (innermost open span, else the
     attached remote context, else ``None``)."""
     return _TRACER.current_context()
+
+
+def current_trace_hex() -> Optional[str]:
+    """The ambient trace id as the 32-hex exemplar form histograms pin
+    to buckets (``None`` outside any trace) — what callers observing a
+    latency on the request thread pass as the explicit ``exemplar=``
+    when the observation must not silently lose its trace link across
+    a later thread handoff."""
+    ctx = _TRACER.current_context()
+    return f"{ctx.trace_id:032x}" if ctx is not None else None

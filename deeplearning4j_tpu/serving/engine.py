@@ -84,7 +84,8 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..monitor.locks import make_lock
-from .admission import SloAdmissionController
+from .admission import (DEFAULT_TENANT, SloAdmissionController,
+                        normalize_tenant, publish_tenant_telemetry)
 from .bucketing import BucketPolicy, assemble_batch
 
 
@@ -107,25 +108,30 @@ class SloShed(ServingError):
     """Raised when admission control sheds the request: the engine's
     observed p99 latency exceeds its SLO target.  Distinct from
     :class:`QueueFull` — the queue may have room; admitting more load
-    would break the latency target for everyone already admitted."""
+    would break the latency target for everyone already admitted.
+    ``tenant`` is the (normalized) tenant whose request was shed —
+    under fair admission that is usually the over-share offender."""
 
     def __init__(self, msg: str, slo_p99_ms: float,
-                 observed_p99_ms: float):
+                 observed_p99_ms: float, tenant: str = DEFAULT_TENANT):
         super().__init__(msg)
         self.slo_p99_ms = float(slo_p99_ms)
         self.observed_p99_ms = float(observed_p99_ms)
+        self.tenant = str(tenant)
 
 
 class _Request:
     __slots__ = ("arrays", "n_rows", "sig", "version", "t_enqueue",
                  "t_wall", "t_dequeue", "ctx", "trace_id", "span_id",
-                 "future")
+                 "tenant", "future")
 
-    def __init__(self, arrays, n_rows, sig, version):
+    def __init__(self, arrays, n_rows, sig, version,
+                 tenant=DEFAULT_TENANT):
         self.arrays = arrays
         self.n_rows = n_rows
         self.sig = sig
         self.version = version
+        self.tenant = tenant
         self.t_enqueue = time.perf_counter()
         self.t_wall = time.time()
         self.t_dequeue = self.t_enqueue
@@ -178,6 +184,8 @@ class InferenceEngine:
                  num_workers: int = 1, devices=None,
                  backend: str = "aot", dtype=None, name: str = "default",
                  slo_p99_ms: Optional[float] = None,
+                 tenants: Optional[dict] = None,
+                 admission: Optional[SloAdmissionController] = None,
                  quantize: Optional[str] = None,
                  session_ttl_s: float = 300.0, max_sessions: int = 1024):
         from ..nn.computation_graph import ComputationGraph
@@ -257,8 +265,16 @@ class InferenceEngine:
         self._compile_lock = make_lock("serving.engine.compile")
         self._running = False
         self._threads: List[threading.Thread] = []
-        self._admission = (SloAdmissionController(slo_p99_ms)
-                           if slo_p99_ms else None)
+        if admission is not None:
+            # a pre-configured controller (observe-only mode, custom
+            # windows, ...) overrides the slo_p99_ms shorthand
+            self._admission: Optional[SloAdmissionController] = admission
+        else:
+            self._admission = (
+                SloAdmissionController(slo_p99_ms, tenants=tenants)
+                if slo_p99_ms else None)
+        # rate limit for the per-tenant gauge/scoreboard publication
+        self._tenant_pub_at = float("-inf")
         self._sessions = None
         self._session_opts = {"ttl_s": float(session_ttl_s),
                               "max_sessions": int(max_sessions)}
@@ -287,7 +303,8 @@ class InferenceEngine:
 
     def _observe_latency(self, latency_ms: float,
                          trace_hex: Optional[str] = None,
-                         version: Optional[int] = None) -> None:
+                         version: Optional[int] = None,
+                         tenant: str = DEFAULT_TENANT) -> None:
         _monitor.histogram(
             "serving_request_latency_ms",
             "end-to-end request latency (enqueue -> result), per model"
@@ -299,9 +316,41 @@ class InferenceEngine:
                 "serving_version_latency_ms",
                 "request latency per served weight version").observe(
                 latency_ms, model=self._name, version=str(version))
+        # per-tenant latency series: exemplars only for the tenant's
+        # slowest decile (windowed p90 cut), so /metrics points an
+        # engineer at traces of the requests dragging that tenant's
+        # tail — not at a uniformly random sample
+        slow_ms = (self._admission.tenant_slow_threshold_ms(tenant)
+                   if self._admission is not None else None)
+        _monitor.histogram(
+            "serving_tenant_latency_ms",
+            "end-to-end request latency per tenant; exemplars pin the "
+            "tenant's slowest-decile requests").observe(
+            latency_ms,
+            exemplar=(trace_hex or "") if (
+                slow_ms is not None and latency_ms >= slow_ms) else "",
+            model=self._name, tenant=tenant)
         if self._admission is not None:
-            self._admission.observe(latency_ms)
+            self._admission.observe(latency_ms, tenant=tenant)
+            self._maybe_publish_tenants()
         self._done_times.append(time.monotonic())
+
+    def _maybe_publish_tenants(self) -> None:
+        """Refresh the per-tenant scoreboard gauges at most once per
+        admission refresh interval (the completion path stays O(1))."""
+        now = time.monotonic()
+        interval = max(0.1, 2.0 * self._admission.refresh_s)
+        if now - self._tenant_pub_at < interval:
+            return
+        self._tenant_pub_at = now
+        publish_tenant_telemetry(self._admission, self._name)
+
+    def _tenant(self, tenant) -> str:
+        """Normalize a request's tenant id against the configured
+        tenants (bounded label cardinality; see admission module)."""
+        if self._admission is not None:
+            return self._admission.normalize(tenant)
+        return normalize_tenant(tenant)
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "InferenceEngine":
@@ -351,24 +400,47 @@ class InferenceEngine:
         self.stop()
 
     # ---------------------------------------------------------- admission
-    def _admit_or_shed(self) -> None:
+    def _admit_or_shed(self, tenant=None) -> str:
+        """Run the (per-tenant, fair) admission decision; returns the
+        normalized tenant label, raises :class:`SloShed` on shed."""
+        tenant = self._tenant(tenant)
+        _monitor.counter(
+            "serving_tenant_requests_total",
+            "requests arriving at admission, per tenant").inc(
+            engine=self._name, tenant=tenant)
         if self._admission is None:
-            return
-        observed = self._admission.should_shed()
+            _monitor.counter(
+                "serving_tenant_admitted_total",
+                "requests admitted past SLO admission, per tenant").inc(
+                engine=self._name, tenant=tenant)
+            return tenant
+        observed = self._admission.should_shed(tenant)
         if observed is not None:
             _monitor.counter(
                 "serving_shed_total",
                 "requests shed by SLO admission control "
                 "(p99 over target)").inc(engine=self._name)
+            _monitor.counter(
+                "serving_tenant_shed_total",
+                "requests shed by SLO admission control, per tenant"
+            ).inc(engine=self._name, tenant=tenant)
             _monitor.record_incident("slo_shed", {
                 "engine": self._name,
+                "tenant": tenant,
                 "observed_p99_ms": float(observed),
                 "slo_p99_ms": float(self._admission.slo_p99_ms),
             })
             raise SloShed(
-                f"shedding: observed p99 {observed:.1f} ms exceeds the "
+                f"shedding tenant {tenant!r}: observed p99 "
+                f"{observed:.1f} ms exceeds the "
                 f"{self._admission.slo_p99_ms:.1f} ms SLO; retry with "
-                "backoff", self._admission.slo_p99_ms, observed)
+                "backoff", self._admission.slo_p99_ms, observed,
+                tenant=tenant)
+        _monitor.counter(
+            "serving_tenant_admitted_total",
+            "requests admitted past SLO admission, per tenant").inc(
+            engine=self._name, tenant=tenant)
+        return tenant
 
     def drain_rate(self) -> float:
         """Completed requests per second over the recent completion
@@ -405,7 +477,8 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- submit
     def predict(self, features, timeout: Optional[float] = None,
-                block: bool = True, version: Optional[int] = None):
+                block: bool = True, version: Optional[int] = None,
+                tenant: Optional[str] = None):
         """Blocking inference: enqueue, coalesce, return this request's
         rows (thread-safe; the engine batches concurrent callers).
         ``block=False`` rejects with ``QueueFull`` instead of waiting
@@ -413,13 +486,18 @@ class InferenceEngine:
         bounded queue IS the buffer and saturation must 429.
         ``version=`` pins the request to a specific staged weight
         version (the rollout controller's probe path); the default
-        routes active/canary per the configured canary fraction."""
+        routes active/canary per the configured canary fraction.
+        ``tenant=`` attributes the request to a tenant for fair
+        admission and per-tenant telemetry (default: the public
+        tenant)."""
         return self.predict_async(features, block=block,
-                                  version=version).result(timeout)
+                                  version=version,
+                                  tenant=tenant).result(timeout)
 
     def predict_async(self, features, block: bool = True,
                       timeout: Optional[float] = None,
-                      version: Optional[int] = None) -> Future:
+                      version: Optional[int] = None,
+                      tenant: Optional[str] = None) -> Future:
         """Enqueue and return a ``Future``.  With ``block=False`` (or a
         ``timeout``) a full queue raises ``QueueFull`` instead of
         blocking — the explicit backpressure signal.  With an SLO
@@ -427,11 +505,11 @@ class InferenceEngine:
         queue room."""
         if not self._running:
             raise ServingError("engine not started (call start())")
-        self._admit_or_shed()
+        tenant = self._admit_or_shed(tenant)
         arrays = self._canonicalize(features)
         sig = self._signature(arrays)
         req = _Request(arrays, int(arrays[0].shape[0]), sig,
-                       self._route_version(version))
+                       self._route_version(version), tenant=tenant)
         try:
             self._queue.put(req, block=block, timeout=timeout)
         except queue.Full:
@@ -489,7 +567,8 @@ class InferenceEngine:
                     **self._session_opts)
             return self._sessions
 
-    def predict_session(self, session_id: str, features):
+    def predict_session(self, session_id: str, features,
+                        tenant: Optional[str] = None):
         """Streaming inference: advance ``session_id``'s device-resident
         state tree (RNN carries, or KV-cache rings for decode models) by
         the given timesteps — ONE dispatch per step (per token for
@@ -499,14 +578,16 @@ class InferenceEngine:
         distinct sessions run concurrently."""
         if not self._running:
             raise ServingError("engine not started (call start())")
-        self._admit_or_shed()
+        tenant = self._admit_or_shed(tenant)
         t0 = time.perf_counter()
         out = self.sessions.step(session_id, features,
                                  dtype=self._dtype)
         _monitor.counter("serving_requests_total",
                          "requests admitted to the serving queue").inc(
             engine=self._name)
-        self._observe_latency((time.perf_counter() - t0) * 1000.0)
+        self._observe_latency((time.perf_counter() - t0) * 1000.0,
+                              _monitor.current_trace_hex(),
+                              tenant=tenant)
         return out
 
     # ------------------------------------------------------------- warmup
@@ -918,6 +999,7 @@ class InferenceEngine:
         }
         if self._admission is not None:
             d["admission"] = self._admission.snapshot()
+            d["tenants"] = self._admission.tenant_snapshot()
         if self._sessions is not None:
             d["sessions"] = self._sessions.stats()
         return d
@@ -1170,7 +1252,7 @@ class InferenceEngine:
             r.future.set_result(sl[0] if len(sl) == 1 else sl)
             self._observe_latency((now - r.t_enqueue) * 1000.0,
                                   f"{r.trace_id:032x}",
-                                  version=job.version)
+                                  version=job.version, tenant=r.tenant)
             off += r.n_rows
 
     def _record_batch_spans(self, job: _BatchJob, t_exec0: float,

@@ -26,6 +26,14 @@ cluster-serving layer rebuilt on our own wire:
   fleet_rules`) against them; a firing scale-out rule adds a worker,
   a firing scale-in rule drains and stops one (never below
   ``min_workers``).
+- **Tenant watch**: the router runs an observe-only (``enforce=False``)
+  :class:`~.admission.SloAdmissionController` — per-request it accounts
+  the tenant's router-observed latency and the worker's admit/shed
+  verdict, each health tick it publishes the per-tenant scoreboard
+  gauges (``serving_tenant_p99_ms{engine="fleet-router"}`` etc.) and
+  evaluates its private rules, so the cross-tenant ``tenant_unfairness``
+  alert fires at the fleet front door without double-shedding in front
+  of the workers' own enforcing controllers.
 - **Route fractions**: sessionless traffic is split by per-worker
   weights (deficit round-robin — deterministic, exact), which is the
   canary generalized to processes: ``set_route_fraction("w2", 0.05)``
@@ -64,6 +72,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import monitor as _monitor
 from ..monitor.locks import make_lock
 from . import compile_cache
+from .admission import SloAdmissionController, publish_tenant_telemetry
 
 ENV_SPAWN_TIMEOUT = "DL4J_TPU_FLEET_SPAWN_TIMEOUT_S"
 #: default fleet width when ``FleetRouter`` is built without ``k``
@@ -357,7 +366,8 @@ class FleetRouter:
                  request_timeout_s: float = 30.0,
                  spawn_timeout_s: Optional[float] = None,
                  sanitize: bool = False, seed: int = 11,
-                 vnodes: int = 64):
+                 vnodes: int = 64,
+                 tenants: Optional[Dict[str, dict]] = None):
         if k is None:
             k = int(os.environ.get(ENV_WORKERS, "2"))
         if k < 1:
@@ -395,6 +405,13 @@ class FleetRouter:
             rules=fleet_rules(slo_p99_ms=slo_p99_ms or 100.0,
                               queue_high=self.queue_high),
             interval_s=self.health_interval_s)
+        # observe-only tenant watcher: the router never sheds (its
+        # workers' enforcing controllers do); it accounts per-tenant
+        # latency and worker admit/shed outcomes so the fleet-level
+        # cross-tenant unfairness alert has evidence to fire on
+        self._admission = SloAdmissionController(
+            slo_p99_ms or 100.0, fair=True, enforce=False,
+            tenants=tenants)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "FleetRouter":
@@ -531,6 +548,7 @@ class FleetRouter:
         zero 5xx."""
         session = payload.get("session")
         key = str(session) if session is not None else None
+        tenant = self._admission.normalize(payload.get("tenant"))
         t0 = time.perf_counter()
         tried: List[str] = []
         with self._lock:
@@ -550,6 +568,16 @@ class FleetRouter:
                 continue
             latency_ms = (time.perf_counter() - t0) * 1e3
             self._latency_window.append(latency_ms)
+            # account the worker's verdict at the fleet grain: a 503
+            # with shed=True is the worker's controller shedding this
+            # tenant; a 200 feeds the tenant's router-observed latency
+            # window (429s and other statuses are neither evidence)
+            shed = (code == 503 and isinstance(body, dict)
+                    and bool(body.get("shed")))
+            if shed or code == 200:
+                self._admission.account(tenant, shed)
+            if code == 200:
+                self._admission.observe(latency_ms, tenant=tenant)
             _monitor.counter(
                 "fleet_requests_total",
                 "requests routed through the fleet front door, by "
@@ -650,6 +678,14 @@ class FleetRouter:
         for name in dead:
             self._respawn(name)
         self._publish_gauges(queue_depth=queue_depth)
+        try:
+            publish_tenant_telemetry(self._admission, "fleet-router")
+        except Exception:
+            pass
+        # evaluated every tick — not just when elastic — so the
+        # cross-tenant unfairness rule watches any fleet; the scale
+        # rules only *act* when elasticity is on
+        self._alerts.evaluate_once()
         if self.elastic:
             self._elastic_tick()
 
@@ -714,7 +750,6 @@ class FleetRouter:
 
     # ------------------------------------------------------------- elastic
     def _elastic_tick(self) -> None:
-        self._alerts.evaluate_once()
         firing = set(self._alerts.firing())
         now = time.monotonic()
         if now - self._last_scale < self.scale_cooldown_s:
@@ -795,6 +830,8 @@ class FleetRouter:
             "elastic": self.elastic,
             "scale_events": list(self._scale_events),
             "window_p99_ms": self.window_p99_ms(),
+            "tenants": self._admission.tenant_snapshot(),
+            "unfairness": self._admission.unfairness(),
             "store_dir": self.store_dir,
             "compile_cache": compile_cache.stats(
                 self.cache_root) if self.cache_root else None,

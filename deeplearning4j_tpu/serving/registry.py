@@ -132,21 +132,24 @@ class ModelRegistry:
     def predict(self, name: str, features, *,
                 session: Optional[str] = None,
                 timeout: Optional[float] = None, block: bool = True,
-                version: Optional[int] = None):
+                version: Optional[int] = None,
+                tenant: Optional[str] = None):
         """Route one request to ``name``, paging its weights in first.
 
         With ``session=``, routes through the engine's device-resident
         session cache (one timestep dispatch); otherwise through the
         dynamic batcher.  ``version=`` pins the request to a staged
-        weight version (the rollout controller's probe path).  Raises
-        :class:`UnknownModel` / ``QueueFull`` / ``SloShed`` per the
-        usual contracts.
+        weight version (the rollout controller's probe path).
+        ``tenant=`` attributes the request for fair admission and
+        per-tenant telemetry.  Raises :class:`UnknownModel` /
+        ``QueueFull`` / ``SloShed`` per the usual contracts.
         """
         engine = self._touch(name)
         if session is not None:
-            return engine.predict_session(session, features)
+            return engine.predict_session(session, features,
+                                          tenant=tenant)
         return engine.predict(features, timeout=timeout, block=block,
-                              version=version)
+                              version=version, tenant=tenant)
 
     # --------------------------------------------------------- deployment
     def swap_weights(self, name: str, params, *,

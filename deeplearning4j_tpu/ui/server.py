@@ -46,9 +46,14 @@ Model serving (the ``serving`` package's multi-tenant engine):
                       routing, 404 for unknown names), ``"session"``
                       (device-resident RNN session id — one timestep
                       dispatch per call), ``"engine"`` (attached-engine
-                      name) and ``"timeout"`` (seconds).
+                      name), ``"timeout"`` (seconds) and ``"tenant"``
+                      (fair-admission tenant id; absent/unknown ids
+                      normalize to the public tenant).
     GET  /models   -> registry hosting view: per-model residency,
                       bytes, quantization, queue depth, SLO.
+    GET  /tenants  -> per-tenant SLO scoreboard: windowed p50/p99 vs
+                      target, shed rate, error-budget burn rate, and
+                      cross-tenant unfairness evidence per engine.
 
     Overload responses are distinct and actionable: 429 when the
     bounded queue rejects (with a ``Retry-After`` header derived from
@@ -387,6 +392,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.deploy_data())
         elif path == "/fleet":
             self._json(ui.fleet_data())
+        elif path == "/tenants":
+            self._json(ui.tenants_data())
         else:
             self._send(404, json.dumps(
                 {"error": "not found", "path": path}).encode())
@@ -432,6 +439,9 @@ class _Handler(BaseHTTPRequestHandler):
         registry = ui.get_registry()
         model = payload.get("model")
         session = payload.get("session")
+        # tenant rides the payload end to end (absent/unknown ids
+        # normalize to the public tenant at the engine's edge)
+        tenant = payload.get("tenant")
         try:
             if "inputs" in payload:
                 feats = tuple(_np.asarray(a) for a in payload["inputs"])
@@ -446,7 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
             # the connection open
             if registry is not None and model is not None:
                 out = registry.predict(model, feats, session=session,
-                                       timeout=timeout, block=False)
+                                       timeout=timeout, block=False,
+                                       tenant=tenant)
             else:
                 engine = ui.get_inference(payload.get("engine"))
                 if engine is None and registry is not None:
@@ -459,10 +470,11 @@ class _Handler(BaseHTTPRequestHandler):
                          "engine": payload.get("engine")}).encode())
                     return
                 if session is not None:
-                    out = engine.predict_session(session, feats)
+                    out = engine.predict_session(session, feats,
+                                                 tenant=tenant)
                 else:
                     out = engine.predict(feats, timeout=timeout,
-                                         block=False)
+                                         block=False, tenant=tenant)
         except UnknownModel as e:
             self._send(404, json.dumps(
                 {"error": f"unknown model {model!r}",
@@ -473,6 +485,7 @@ class _Handler(BaseHTTPRequestHandler):
             # can distinguish "overloaded" from "misconfigured"
             self._send(503, json.dumps(
                 {"error": str(e), "shed": True,
+                 "tenant": e.tenant,
                  "slo_p99_ms": e.slo_p99_ms,
                  "observed_p99_ms": e.observed_p99_ms}).encode(),
                 headers={"Retry-After": "1"})
@@ -661,6 +674,135 @@ class UIServer:
         data = self._fleet.status()
         data["attached"] = True
         return data
+
+    # ---- tenant SLO scoreboard (GET /tenants) ----------------------------
+    def _tenant_engines(self) -> dict:
+        """Every engine this server fronts (standalone attachments plus
+        the registry's, no paging side effects)."""
+        engines = dict(self._engines)
+        if self._registry is not None:
+            for name in self._registry.names():
+                try:
+                    engines.setdefault(name, self._registry.get(name))
+                except Exception:
+                    pass
+        return engines
+
+    def tenants_data(self) -> dict:
+        """``GET /tenants`` body: the per-tenant SLO scoreboard.
+
+        Per tenant (merged worst-case across every fronted engine):
+        windowed p50/p99 against the tenant's SLO target, admission
+        decision counts and shed rate, the unloaded-baseline inflation,
+        and the lifetime error-budget burn rate computed from the
+        ``serving_tenant_latency_ms`` bucket ladder (bad = observations
+        over the tenant's SLO, objective 99%).  ``engines`` carries each
+        admission controller's raw snapshot including the cross-tenant
+        unfairness evidence the alert rule thresholds on."""
+        import re as _re
+        from ..monitor.alerts import _bad_good
+        from ..serving.admission import DEFAULT_TENANT
+        objective = 0.99
+        tenants: dict = {}
+        engines: dict = {}
+
+        def merge(tenant: str, row: dict) -> None:
+            agg = tenants.setdefault(tenant, {
+                "slo_p99_ms": None, "window_p50_ms": None,
+                "window_p99_ms": None, "baseline_p99_ms": None,
+                "inflation_x": None, "slo_ok": True,
+                "window_admitted": 0, "window_shed": 0,
+                "shed_rate": 0.0, "burn_rate": None,
+                "requests": 0.0, "admitted": 0.0, "shed": 0.0,
+            })
+            if row.get("slo_p99_ms") is not None:
+                agg["slo_p99_ms"] = (
+                    row["slo_p99_ms"] if agg["slo_p99_ms"] is None
+                    else min(agg["slo_p99_ms"], row["slo_p99_ms"]))
+            for key in ("window_p50_ms", "window_p99_ms",
+                        "inflation_x"):
+                if row.get(key) is not None:
+                    agg[key] = (row[key] if agg[key] is None
+                                else max(agg[key], row[key]))
+            if row.get("baseline_p99_ms") is not None:
+                agg["baseline_p99_ms"] = (
+                    row["baseline_p99_ms"]
+                    if agg["baseline_p99_ms"] is None
+                    else min(agg["baseline_p99_ms"],
+                             row["baseline_p99_ms"]))
+            agg["slo_ok"] = agg["slo_ok"] and row.get("slo_ok", True)
+            agg["window_admitted"] += row.get("window_admitted", 0)
+            agg["window_shed"] += row.get("window_shed", 0)
+            decided = agg["window_admitted"] + agg["window_shed"]
+            agg["shed_rate"] = (round(agg["window_shed"] / decided, 4)
+                                if decided else 0.0)
+
+        sources = list(self._tenant_engines().items())
+        fleet = self.get_fleet()
+        if fleet is not None and getattr(fleet, "_admission",
+                                         None) is not None:
+            sources.append(("fleet-router", fleet))
+        for name, eng in sources:
+            adm = getattr(eng, "_admission", None)
+            if adm is None:
+                continue
+            rows = adm.tenant_snapshot()
+            engines[name] = {
+                "slo_p99_ms": adm.slo_p99_ms,
+                "fair": adm.fair, "enforce": adm.enforce,
+                "window_p99_ms": adm.window_p99(),
+                "unfairness": adm.unfairness(),
+                "tenants": rows,
+            }
+            for tenant, row in rows.items():
+                merge(tenant, row)
+
+        # lifetime counters + bucket-ladder burn per tenant label
+        snap = _monitor.snapshot()
+
+        def label_tenant(key: str):
+            m = _re.search(r'tenant="([^"]*)"', key)
+            return m.group(1) if m else None
+
+        for metric, field in (("serving_tenant_requests_total",
+                               "requests"),
+                              ("serving_tenant_admitted_total",
+                               "admitted"),
+                              ("serving_tenant_shed_total", "shed")):
+            for key, val in snap.get(metric, {}).get("values",
+                                                     {}).items():
+                tenant = label_tenant(key)
+                if tenant is None:
+                    continue
+                if tenant not in tenants:
+                    merge(tenant, {})
+                tenants[tenant][field] += float(val)
+        for key, val in snap.get("serving_tenant_latency_ms",
+                                 {}).get("values", {}).items():
+            tenant = label_tenant(key)
+            if tenant is None:
+                continue
+            if tenant not in tenants:
+                merge(tenant, {})
+            agg = tenants[tenant]
+            slo = agg.get("slo_p99_ms") or 50.0
+            total, bad = _bad_good(val, slo)
+            if total:
+                burn = (bad / total) / (1.0 - objective)
+                agg["burn_rate"] = round(
+                    burn if agg["burn_rate"] is None
+                    else max(agg["burn_rate"], burn), 3)
+                stats = val if isinstance(val, dict) else {}
+                for src, dst in (("p50", "lifetime_p50_ms"),
+                                 ("p99", "lifetime_p99_ms")):
+                    if stats.get(src) is not None:
+                        agg[dst] = (
+                            round(stats[src], 3)
+                            if agg.get(dst) is None
+                            else round(max(agg[dst], stats[src]), 3))
+        return {"default_tenant": DEFAULT_TENANT,
+                "objective": objective,
+                "tenants": tenants, "engines": engines}
 
     # ---- deployment control plane (POST /deploy/{model}) -----------------
     def attach_deployment(self, controller) -> "UIServer":

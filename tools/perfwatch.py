@@ -28,6 +28,11 @@ Checks (each failed check is one finding):
   ``decode_tokens_per_sec`` (the ``bench.py --decode`` KV-ring
   one-dispatch-per-token rate, headline or extra field) form another
   sparse series with the same trailing-median gate.
+- **traffic throughput drop** — rounds carrying
+  ``traffic_admitted_rps`` (the ``bench.py --traffic`` open-loop
+  fair-admission admitted rate under the seeded multi-tenant overload,
+  headline or extra field) form a third sparse series with the same
+  trailing-median gate.
 
 Output: findings on stdout (``--json`` for machine-readable) and a
 ``PERF_REPORT.md`` snapshot of the trajectory + verdicts (suppress with
@@ -91,6 +96,10 @@ def load_rounds(root: str) -> list:
         if decode_tps is None \
                 and parsed.get("metric") == "decode_tokens_per_sec":
             decode_tps = parsed.get("value")
+        traffic_rps = parsed.get("traffic_admitted_rps")
+        if traffic_rps is None \
+                and parsed.get("metric") == "traffic_admitted_rps":
+            traffic_rps = parsed.get("value")
         rounds.append({
             "round": int(doc.get("n", m.group(1))),
             "file": os.path.basename(path),
@@ -102,6 +111,7 @@ def load_rounds(root: str) -> list:
             "hbm_bytes_per_step": parsed.get("hbm_bytes_per_step"),
             "fleet_requests_per_sec": fleet_rps,
             "decode_tokens_per_sec": decode_tps,
+            "traffic_admitted_rps": traffic_rps,
         })
     rounds.sort(key=lambda r: r["round"])
     return rounds
@@ -203,6 +213,40 @@ def check_decode_throughput(rounds: list, tolerance: float,
     return []
 
 
+def check_traffic_throughput(rounds: list, tolerance: float,
+                             trailing: int) -> list:
+    """Newest open-loop fair-admission admitted rate vs its trailing
+    median.
+
+    Rounds carrying ``traffic_admitted_rps`` (the ``bench.py
+    --traffic`` multi-tenant overload harness) are sparse like the
+    fleet and decode series; the admitted rate under the seeded
+    offender is the capacity the fair controller actually serves, so a
+    drop here is an admission/batching regression even when the
+    headline single-model rate holds."""
+    usable = [r for r in rounds
+              if r["traffic_admitted_rps"] is not None
+              and r["rc"] == 0]
+    if len(usable) < 2:
+        return []
+    head = usable[-1]
+    prior = [r["traffic_admitted_rps"] for r in usable[:-1]][-trailing:]
+    base = statistics.median(prior)
+    if base <= 0:
+        return []
+    drop = (base - head["traffic_admitted_rps"]) / base
+    head["traffic_drop_vs_trailing"] = round(drop, 4)
+    if drop > tolerance:
+        return [Finding(
+            "traffic-throughput",
+            f"{head['file']}: traffic_admitted_rps = "
+            f"{head['traffic_admitted_rps']:.1f} is "
+            f"{drop * 100:.1f}% below the trailing median {base:.1f} "
+            f"of the previous {len(prior)} traffic round(s) "
+            f"(tolerance {tolerance * 100:.0f}%)")]
+    return []
+
+
 def check_bytes(rounds: list, tolerance: float) -> list:
     """Newest recorded hbm_bytes_per_step vs the history minimum."""
     series = [(r["file"], r["hbm_bytes_per_step"]) for r in rounds
@@ -240,8 +284,8 @@ def write_report(path: str, rounds: list, findings: list,
         "## Trajectory",
         "",
         "| round | metric | value | batch | hbm bytes/step "
-        "| fleet req/s | decode tok/s | rc |",
-        "|---|---|---|---|---|---|---|---|",
+        "| fleet req/s | decode tok/s | traffic req/s | rc |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rounds:
         value = "-" if r["value"] is None else f"{r['value']:.1f}"
@@ -251,10 +295,12 @@ def write_report(path: str, rounds: list, findings: list,
                  else f"{r['fleet_requests_per_sec']:.1f}")
         decode = ("-" if r.get("decode_tokens_per_sec") is None
                   else f"{r['decode_tokens_per_sec']:.1f}")
+        traffic = ("-" if r.get("traffic_admitted_rps") is None
+                   else f"{r['traffic_admitted_rps']:.1f}")
         lines.append(
             f"| r{r['round']:02d} | {r['metric'] or '-'} | {value} "
             f"| {r['batch'] or '-'} | {hbm} | {fleet} | {decode} "
-            f"| {r['rc']} |")
+            f"| {traffic} | {r['rc']} |")
     lines += ["", "## Verdict", ""]
     if findings:
         lines += [f"- **FAIL** {f}" for f in findings]
@@ -284,6 +330,8 @@ def run(root: str, args) -> list:
                                        args.trailing)
     findings += check_decode_throughput(rounds, args.tolerance,
                                         args.trailing)
+    findings += check_traffic_throughput(rounds, args.tolerance,
+                                         args.trailing)
     findings += check_bytes(rounds, args.bytes_tolerance)
     if not args.no_report:
         write_report(args.report or os.path.join(root, "PERF_REPORT.md"),
